@@ -1,0 +1,36 @@
+"""raft_meets_dicl_tpu — a TPU-native optical-flow research framework.
+
+A brand-new JAX/XLA/Pallas/pjit implementation of the capabilities of the
+PyTorch reference framework "RAFT meets DICL" (qzed/raft-meets-dicl): a
+config-driven model zoo (RAFT, DICL, and RAFT+DICL hybrids), a composable
+dataset pipeline with rich augmentation, multi-stage training strategies,
+metric-driven checkpoint management, inspection/validation machinery, and a
+full evaluation/visualization CLI.
+
+Layout (mirrors the reference's layer map, SURVEY.md §1, redesigned TPU-first):
+
+- ``utils/``    — config load/store, expression evaluator, seeds (numpy +
+                  ``jax.random`` key discipline), logging, misc.
+- ``data/``     — host-side numpy dataset pipeline (I/O, layouts,
+                  augmentations, combinators). Torch-free.
+- ``ops/``      — the TPU compute layer: correlation volumes, bilinear
+                  sampling/warping, convex upsampling; XLA-composite
+                  implementations with Pallas kernels for the hot paths.
+                  This replaces the reference's fused CUDA ops
+                  (matmul/grid_sample/unfold per reference
+                  src/models/impls/raft.py:31,80,323).
+- ``models/``   — model framework (registry, adapters, input spec) and the
+                  model zoo as Flax modules with ``lax.scan`` recurrence.
+- ``parallel/`` — device mesh / sharding layer: SPMD data-parallel train
+                  steps over ICI via ``jax.sharding`` + ``shard_map``
+                  (replaces the reference's ``nn.DataParallel``,
+                  reference src/cmd/train.py:183-184).
+- ``strategy/`` — multi-stage training strategies, optimizers/schedulers
+                  (optax), gradient handling, checkpoint management.
+- ``evaluation/`` ``metrics/`` ``inspect/`` ``visual/`` — evaluation loop,
+                  metric registry, TensorBoard inspection + hooks, flow
+                  visualization.
+- ``cmd/``      — CLI subcommands (train / evaluate / checkpoint / gencfg).
+"""
+
+__version__ = "0.1.0"

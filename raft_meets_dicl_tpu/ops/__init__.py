@@ -1,0 +1,19 @@
+"""TPU compute ops.
+
+This package is the TPU-native equivalent of the reference's fused CUDA ops
+(``torch.matmul`` all-pairs correlation, ``F.grid_sample`` lookups/warps,
+``F.avg_pool2d`` pyramids, ``F.unfold`` convex upsampling — reference
+src/models/impls/raft.py:31,42,80,323 and src/models/common/warp.py:27).
+
+All ops use the TPU-native NHWC layout; flow fields are ``(..., H, W, 2)``
+with channel 0 = horizontal (u/x) and channel 1 = vertical (v/y)
+displacement. Implementations are XLA-composite by default (einsum on the
+MXU, vectorized gathers) with Pallas kernels for hot paths where profiling
+justifies them (see ``ops.pallas``).
+"""
+
+from .sample import grid_sample, sample_bilinear
+from .pool import avg_pool2d, max_pool2d
+from .corr import all_pairs_correlation, correlation_pyramid, lookup_pyramid, CorrVolume
+from .upsample import convex_upsample_8x, interpolate_bilinear, upsample_flow_2x
+from .warp import warp_backwards, coordinate_grid

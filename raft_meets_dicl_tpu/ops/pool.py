@@ -1,0 +1,28 @@
+"""Window pooling for NHWC tensors (reference uses ``F.avg_pool2d`` /
+``F.max_pool2d`` for correlation pyramids and pooled encoders,
+src/models/impls/raft.py:42, src/models/common/encoders/pool/*)."""
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def avg_pool2d(x, window=2, stride=None):
+    """Average pool over the H, W axes of an (..., H, W, C) tensor."""
+    stride = stride or window
+    n = x.ndim
+    dims = [1] * n
+    strides = [1] * n
+    dims[-3] = dims[-2] = window
+    strides[-3] = strides[-2] = stride
+    summed = lax.reduce_window(x, 0.0, lax.add, tuple(dims), tuple(strides), "VALID")
+    return summed / (window * window)
+
+
+def max_pool2d(x, window=2, stride=None):
+    stride = stride or window
+    n = x.ndim
+    dims = [1] * n
+    strides = [1] * n
+    dims[-3] = dims[-2] = window
+    strides[-3] = strides[-2] = stride
+    return lax.reduce_window(x, -jnp.inf, lax.max, tuple(dims), tuple(strides), "VALID")

@@ -1,0 +1,70 @@
+"""Bilinear sampling with exact ``torch.nn.functional.grid_sample`` semantics.
+
+The reference leans on ``F.grid_sample(..., align_corners=True)`` (default
+zero padding) for correlation-volume lookups (src/models/impls/raft.py:80),
+backwards warping (src/models/common/warp.py:27), and DICL cost sampling.
+EPE-parity requires matching those semantics exactly: with
+``align_corners=True`` a normalized coordinate ``g`` maps to pixel position
+``(g + 1) / 2 * (size - 1)``, interpolation is bilinear from the four
+surrounding pixels, and any corner outside the image contributes zero.
+
+Layout is NHWC (TPU-native); the reference is NCHW.
+"""
+
+import jax.numpy as jnp
+
+
+def sample_bilinear(img, x, y):
+    """Sample ``img`` at pixel coordinates with zero padding outside.
+
+    img: (..., H, W, C) — batch dims broadcast against coordinate batch dims.
+    x, y: (..., *S) float pixel coordinates (x along W, y along H).
+
+    Returns (..., *S, C). Out-of-bounds corner contributions are zero,
+    matching torch's ``padding_mode='zeros'``.
+    """
+    H, W, C = img.shape[-3], img.shape[-2], img.shape[-1]
+
+    x0 = jnp.floor(x)
+    y0 = jnp.floor(y)
+    x1 = x0 + 1
+    y1 = y0 + 1
+
+    wx1 = x - x0
+    wy1 = y - y0
+    wx0 = 1.0 - wx1
+    wy0 = 1.0 - wy1
+
+    def gather(ix, iy):
+        inb = (ix >= 0) & (ix <= W - 1) & (iy >= 0) & (iy <= H - 1)
+        ixc = jnp.clip(ix, 0, W - 1).astype(jnp.int32)
+        iyc = jnp.clip(iy, 0, H - 1).astype(jnp.int32)
+        # flatten spatial dims for a single gather
+        flat = img.reshape(*img.shape[:-3], H * W, C)
+        idx = iyc * W + ixc
+        batch_shape = img.shape[:-3]
+        sshape = ix.shape[len(batch_shape):]
+        idxf = idx.reshape(*batch_shape, -1)
+        vals = jnp.take_along_axis(flat, idxf[..., None], axis=-2)
+        vals = vals.reshape(*batch_shape, *sshape, C)
+        return vals * inb[..., None]
+
+    out = (
+        gather(x0, y0) * (wx0 * wy0)[..., None]
+        + gather(x1, y0) * (wx1 * wy0)[..., None]
+        + gather(x0, y1) * (wx0 * wy1)[..., None]
+        + gather(x1, y1) * (wx1 * wy1)[..., None]
+    )
+    return out
+
+
+def grid_sample(img, grid):
+    """``F.grid_sample(img, grid, align_corners=True)`` equivalent, NHWC.
+
+    img: (B, H, W, C); grid: (B, Ho, Wo, 2) normalized coords in [-1, 1],
+    channel 0 = x, channel 1 = y. Returns (B, Ho, Wo, C).
+    """
+    H, W = img.shape[-3], img.shape[-2]
+    gx = (grid[..., 0] + 1.0) * 0.5 * (W - 1)
+    gy = (grid[..., 1] + 1.0) * 0.5 * (H - 1)
+    return sample_bilinear(img, gx, gy)

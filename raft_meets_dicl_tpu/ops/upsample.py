@@ -1,0 +1,73 @@
+"""Flow upsampling: RAFT convex upsampling and align_corners bilinear resize.
+
+Replaces the reference's ``F.unfold``-based ``Up8Network`` math
+(src/models/impls/raft.py:299-331) and ``F.interpolate(mode='bilinear',
+align_corners=True)`` inter-level upsampling. NHWC layout.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .sample import sample_bilinear
+
+
+def _neighbors3x3(x):
+    """Stack the 3x3 neighborhood of each pixel: (B,H,W,C) -> (B,H,W,9,C).
+
+    Neighbor order is (dy, dx) row-major — identical to ``F.unfold`` with a
+    (3, 3) kernel and padding 1 (reference raft.py:323).
+    """
+    b, h, w, c = x.shape
+    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    rows = []
+    for dy in range(3):
+        for dx in range(3):
+            rows.append(xp[:, dy : dy + h, dx : dx + w, :])
+    return jnp.stack(rows, axis=3)
+
+
+def convex_upsample_8x(flow, mask_logits, temperature=4.0, factor=8):
+    """Convex combination upsampling (reference Up8Network, raft.py:313-331).
+
+    flow: (B, H, W, 2); mask_logits: (B, H, W, 9 * factor²) from the mask
+    head, channel layout (neighbor k, sub-row r, sub-col s) — the NHWC analog
+    of the reference's ``view(batch, 1, 9, 8, 8, h, w)``. Returns
+    (B, H*factor, W*factor, 2). The flow is scaled by ``factor`` (coarse-grid
+    displacements to fine-grid displacements).
+    """
+    b, h, w, c = flow.shape
+    f = factor
+
+    mask = mask_logits.reshape(b, h, w, 9, f, f)
+    mask = jax.nn.softmax(mask / temperature, axis=3)
+
+    nbrs = _neighbors3x3(f * flow)  # (B, H, W, 9, 2)
+    up = jnp.einsum("bhwkrs,bhwkc->bhrwsc", mask, nbrs)
+    return up.reshape(b, h * f, w * f, c)
+
+
+def interpolate_bilinear(x, size):
+    """Bilinear resize with ``align_corners=True`` semantics, NHWC.
+
+    Matches ``F.interpolate(x, size, mode='bilinear', align_corners=True)``:
+    output pixel i samples source position i * (in - 1) / (out - 1).
+    """
+    b = x.shape[0]
+    ho, wo = size
+    hi, wi = x.shape[-3], x.shape[-2]
+
+    sy = jnp.linspace(0.0, hi - 1.0, ho)
+    sx = jnp.linspace(0.0, wi - 1.0, wo)
+    gy, gx = jnp.meshgrid(sy, sx, indexing="ij")
+    gx = jnp.broadcast_to(gx, (b, ho, wo))
+    gy = jnp.broadcast_to(gy, (b, ho, wo))
+    return sample_bilinear(x, gx, gy)
+
+
+def upsample_flow_2x(flow, scale_values=True):
+    """Double flow resolution (inter-level upsampling in coarse-to-fine
+    models); optionally scales displacement values by 2 to account for the
+    finer grid."""
+    b, h, w, _ = flow.shape
+    up = interpolate_bilinear(flow, (2 * h, 2 * w))
+    return 2.0 * up if scale_values else up

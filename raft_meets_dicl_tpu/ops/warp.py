@@ -1,0 +1,32 @@
+"""Coordinate grids and backwards warping (reference
+src/models/common/grid.py:4-12, src/models/common/warp.py:5-33). NHWC."""
+
+import jax.numpy as jnp
+
+from .sample import sample_bilinear
+
+
+def coordinate_grid(batch, h, w, dtype=jnp.float32):
+    """(B, H, W, 2) grid of absolute pixel positions, channel 0 = x, 1 = y."""
+    cy, cx = jnp.meshgrid(jnp.arange(h, dtype=dtype), jnp.arange(w, dtype=dtype), indexing="ij")
+    grid = jnp.stack((cx, cy), axis=-1)
+    return jnp.broadcast_to(grid, (batch, h, w, 2))
+
+
+def warp_backwards(img2, flow, eps=1e-5):
+    """Warp img2 back to frame 1 along ``flow``; returns (warped, mask).
+
+    img2: (B, H, W, C); flow: (B, H, W, 2). The mask flags pixels whose
+    sample window lies fully inside the image (bilinear weight of valid
+    pixels > 1 - eps), matching the reference's ones-image trick
+    (warp.py:27-31).
+    """
+    b, h, w, _ = img2.shape
+    pos = coordinate_grid(b, h, w, dtype=flow.dtype) + flow
+    x, y = pos[..., 0], pos[..., 1]
+
+    est = sample_bilinear(img2, x, y)
+    ones = jnp.ones((b, h, w, 1), dtype=img2.dtype)
+    mask = sample_bilinear(ones, x, y) > (1.0 - eps)
+
+    return est * mask, jnp.broadcast_to(mask, est.shape)

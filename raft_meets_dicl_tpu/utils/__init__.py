@@ -1,0 +1,4 @@
+from . import config
+from . import expr
+from . import logging
+from . import seeds

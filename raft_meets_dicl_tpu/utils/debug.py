@@ -1,0 +1,19 @@
+"""Debug helpers: pdb-on-exception wrapper (reference src/utils/debug.py:1-19)."""
+
+import pdb
+import sys
+import traceback
+
+
+def run(fn, *args, debug=False, **kwargs):
+    """Run ``fn``; on exception optionally drop into pdb post-mortem."""
+    if not debug:
+        return fn(*args, **kwargs)
+
+    try:
+        return fn(*args, **kwargs)
+    except Exception:
+        traceback.print_exc()
+        _, _, tb = sys.exc_info()
+        pdb.post_mortem(tb)
+        raise

