@@ -1,0 +1,16 @@
+"""Host-side data pipeline: I/O, datasets, augmentation, pairing.
+
+Everything in this package is pure numpy/cv2 on the host; jax conversion
+happens exclusively in the model input adapter.
+"""
+
+from . import augment, collection, combinators, config, dataset, fw_bw, io, patterns
+from .collection import Collection, Metadata, SampleArgs, SampleId
+from .config import load
+from .fw_bw import estimate_backwards_flow, estimate_backwards_flow_sparse
+
+__all__ = [
+    "augment", "collection", "combinators", "config", "dataset", "fw_bw",
+    "io", "patterns", "Collection", "Metadata", "SampleArgs", "SampleId",
+    "load", "estimate_backwards_flow", "estimate_backwards_flow_sparse",
+]

@@ -1,0 +1,116 @@
+"""Dataset combinators: concat, repeat, and random subset.
+
+Config-compatible with the reference combinators (src/data/concat.py,
+repeat.py, subset.py) but implemented in one module — they are all thin
+index-transformers over a source Collection.
+"""
+
+import numpy as np
+
+from .collection import Collection
+
+
+class Concat(Collection):
+    type = "concat"
+
+    @classmethod
+    def from_config(cls, path, cfg):
+        from . import config as data_config
+
+        cls._typecheck(cfg)
+        return cls([data_config.load(path, c) for c in cfg["sources"]])
+
+    def __init__(self, sources):
+        super().__init__()
+        self.sources = sources
+
+    def get_config(self):
+        return {"type": self.type, "sources": [s.get_config() for s in self.sources]}
+
+    def __getitem__(self, index):
+        if index < 0:
+            index += len(self)
+        if not 0 <= index < len(self):
+            raise IndexError("index out of range")
+        for source in self.sources:
+            if index < len(source):
+                return source[index]
+            index -= len(source)
+        raise IndexError("index out of range")
+
+    def __len__(self):
+        return sum(len(s) for s in self.sources)
+
+    def description(self):
+        return f"[{', '.join(repr(s.description()) for s in self.sources)}]"
+
+
+class Repeat(Collection):
+    type = "repeat"
+
+    @classmethod
+    def from_config(cls, path, cfg):
+        from . import config as data_config
+
+        cls._typecheck(cfg)
+        return cls(cfg["times"], data_config.load(path, cfg["source"]))
+
+    def __init__(self, times, source):
+        super().__init__()
+        self.times = times
+        self.source = source
+
+    def get_config(self):
+        return {
+            "type": self.type,
+            "times": self.times,
+            "source": self.source.get_config(),
+        }
+
+    def __getitem__(self, index):
+        if not 0 <= index < len(self):
+            raise IndexError(
+                f"index '{index}' is out of range for dataset of size '{len(self)}'"
+            )
+        return self.source[index % len(self.source)]
+
+    def __len__(self):
+        return self.times * len(self.source)
+
+    def description(self):
+        return f"{self.source.description()}, repeat times {self.times}"
+
+
+class Subset(Collection):
+    """Random subset with replacement, drawn once at construction."""
+
+    type = "subset"
+
+    @classmethod
+    def from_config(cls, path, cfg):
+        from . import config as data_config
+
+        cls._typecheck(cfg)
+        return cls(cfg["size"], data_config.load(path, cfg["source"]))
+
+    def __init__(self, size, source):
+        super().__init__()
+        self.size = size
+        self.source = source
+        self.map = np.random.randint(0, len(source), size=size)
+
+    def get_config(self):
+        return {
+            "type": self.type,
+            "size": self.size,
+            "source": self.source.get_config(),
+        }
+
+    def __getitem__(self, index):
+        return self.source[self.map[index]]
+
+    def __len__(self):
+        return self.size
+
+    def description(self):
+        return f"{self.source.description()}, subset {self.size}"

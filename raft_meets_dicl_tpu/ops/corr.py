@@ -35,8 +35,13 @@ def all_pairs_correlation(fmap1, fmap2):
 
 
 def _pool2x_last2(corr):
-    """Average-pool the trailing two axes by 2 (reference raft.py:38-47)."""
+    """Average-pool the trailing two axes by 2 (reference raft.py:38-47).
+
+    Odd trailing sizes floor like ``F.avg_pool2d`` does: the last row/column
+    is dropped before the reshape-mean.
+    """
     *lead, h2, w2 = corr.shape
+    corr = corr[..., : h2 // 2 * 2, : w2 // 2 * 2]
     corr = corr.reshape(*lead, h2 // 2, 2, w2 // 2, 2)
     return corr.mean(axis=(-3, -1))
 
@@ -65,31 +70,13 @@ def _lookup_level(corr, x, y):
     """Bilinearly sample a (B, H1, W1, H2, W2) volume at per-position windows.
 
     x, y: (B, H1, W1, K, K) pixel coordinates into the (H2, W2) axes.
-    Returns (B, H1, W1, K, K). Zero padding outside, align_corners=True.
+    Returns (B, H1, W1, K, K). Zero padding outside, align_corners=True —
+    delegates to the shared grid-sample-parity gather with (B, H1, W1) as
+    batch dims.
     """
-    b, h1, w1, h2, w2 = corr.shape
-    flat = corr.reshape(b, h1, w1, h2 * w2)
-    kk = x.shape[-1] * x.shape[-2]
+    from .sample import sample_bilinear
 
-    x0 = jnp.floor(x)
-    y0 = jnp.floor(y)
-    wx1 = x - x0
-    wy1 = y - y0
-
-    def gather(ix, iy):
-        inb = (ix >= 0) & (ix <= w2 - 1) & (iy >= 0) & (iy <= h2 - 1)
-        ixc = jnp.clip(ix, 0, w2 - 1).astype(jnp.int32)
-        iyc = jnp.clip(iy, 0, h2 - 1).astype(jnp.int32)
-        idx = (iyc * w2 + ixc).reshape(b, h1, w1, kk)
-        vals = jnp.take_along_axis(flat, idx, axis=-1).reshape(x.shape)
-        return vals * inb
-
-    return (
-        gather(x0, y0) * (1 - wx1) * (1 - wy1)
-        + gather(x0 + 1, y0) * wx1 * (1 - wy1)
-        + gather(x0, y0 + 1) * (1 - wx1) * wy1
-        + gather(x0 + 1, y0 + 1) * wx1 * wy1
-    )
+    return sample_bilinear(corr[..., None], x, y)[..., 0]
 
 
 def lookup_pyramid(pyramid, coords, radius, mask_costs=()):

@@ -1,0 +1,373 @@
+"""Data-layer tests: I/O round-trips, pattern engine, dataset layouts,
+combinators, augmentations, and backward-flow estimation."""
+
+import numpy as np
+import pytest
+
+from raft_meets_dicl_tpu.data import augment, combinators, fw_bw, io, patterns
+from raft_meets_dicl_tpu.data import config as data_config
+from raft_meets_dicl_tpu.data.collection import Collection, Metadata, SampleArgs, SampleId
+
+
+# -- io ---------------------------------------------------------------------
+
+
+def test_flo_roundtrip(tmp_path):
+    uv = np.random.randn(13, 17, 2).astype(np.float32)
+    io.write_flow_mb(tmp_path / "t.flo", uv)
+    out = io.read_flow_mb(tmp_path / "t.flo")
+    np.testing.assert_array_equal(out, uv)
+
+
+def test_kitti_roundtrip(tmp_path):
+    uv = np.round(np.random.uniform(-100, 100, (11, 7, 2)) * 64) / 64
+    valid = np.random.rand(11, 7) > 0.3
+    io.write_flow_kitti(tmp_path / "t.png", uv.astype(np.float32), valid)
+    flow, v = io.read_flow_kitti(tmp_path / "t.png")
+    np.testing.assert_allclose(flow[v], uv[v].astype(np.float32), atol=1 / 64)
+    np.testing.assert_array_equal(v, valid)
+
+
+def test_pfm_read(tmp_path):
+    data = np.random.rand(5, 4, 3).astype(np.float32)
+    with open(tmp_path / "t.pfm", "wb") as fd:
+        fd.write(b"PF\n4 5\n-1.0\n")
+        data[::-1].astype("<f4").tofile(fd)
+    out = io.read_pfm(tmp_path / "t.pfm")
+    np.testing.assert_allclose(out, data)
+
+
+# -- patterns ---------------------------------------------------------------
+
+
+def test_pattern_glob():
+    assert patterns.to_glob("{type}/{pass}/frame_{idx:04d}.png") == "*/*/frame_*.png"
+
+
+def test_pattern_match_types():
+    p = patterns.FormatPattern("clean/{scene}/frame_{idx:04d}.png")
+    m = p.match("clean/alley_1/frame_0012.png")
+    assert m == {"scene": "alley_1", "idx": 12}
+    assert p.match("final/alley_1/frame_0012.png") is None
+
+
+def test_pattern_match_plain_int():
+    p = patterns.FormatPattern("{seq:05d}_img{idx:d}.ppm")
+    assert p.match("00001_img2.ppm") == {"seq": 1, "idx": 2}
+
+
+def test_pattern_format_is_str_format():
+    pat = "{seq:05d}_img{idx:d}.ppm"
+    assert pat.format(seq=3, idx=1) == "00003_img1.ppm"
+
+
+# -- dataset ----------------------------------------------------------------
+
+
+def _make_sintel_like(root, scenes=("alley_1", "market_2"), frames=4):
+    """Synthetic dataset tree shaped like Sintel with two passes."""
+    for pass_ in ("clean", "final"):
+        for scene in scenes:
+            d = root / "training" / pass_ / scene
+            d.mkdir(parents=True, exist_ok=True)
+            for i in range(1, frames + 1):
+                img = (np.random.rand(8, 12, 3) * 255).astype(np.uint8)
+                import cv2
+
+                cv2.imwrite(str(d / f"frame_{i:04d}.png"), img)
+    for scene in scenes:
+        d = root / "training" / "flow" / scene
+        d.mkdir(parents=True, exist_ok=True)
+        for i in range(1, frames):  # last frame has no flow
+            io.write_flow_mb(d / f"frame_{i:04d}.flo", np.random.randn(8, 12, 2).astype(np.float32))
+
+
+SPEC = {
+    "id": "synthetic-sintel",
+    "name": "Synthetic Sintel",
+    "path": ".",
+    "layout": {
+        "type": "generic",
+        "images": "training/{pass}/{scene}/frame_{idx:04d}.png",
+        "flows": "training/flow/{scene}/frame_{idx:04d}.flo",
+        "key": "{pass}/{scene}/frame_{idx:04d}",
+    },
+    "parameters": {"pass": {"values": ["clean", "final"], "sub": "pass"}},
+}
+
+
+def test_dataset_generic_layout(tmp_path):
+    _make_sintel_like(tmp_path)
+
+    cfg = {"type": "dataset", "spec": SPEC, "parameters": {"pass": "clean"}}
+    ds = data_config.load(tmp_path, cfg)
+
+    # 2 scenes × (4 frames - 1 tail) = 6 samples, clean pass only
+    assert len(ds) == 6
+
+    img1, img2, flow, valid, meta = ds[0]
+    assert img1.shape == (1, 8, 12, 3)
+    assert img2.shape == (1, 8, 12, 3)
+    assert flow.shape == (1, 8, 12, 2)
+    assert valid.shape == (1, 8, 12)
+    assert valid.dtype == bool
+    assert meta[0].dataset_id == "synthetic-sintel"
+    assert "clean" in str(meta[0].sample_id)
+
+    # config round-trips
+    cfg2 = ds.get_config()
+    assert cfg2["type"] == "dataset"
+    assert cfg2["parameters"] == {"pass": "clean"}
+
+
+def test_dataset_backwards_layout(tmp_path):
+    _make_sintel_like(tmp_path)
+
+    spec = dict(SPEC)
+    spec["layout"] = dict(SPEC["layout"], type="generic-backwards")
+
+    ds = data_config.load(tmp_path, {"type": "dataset", "spec": spec,
+                                     "parameters": {"pass": "clean"}})
+    assert len(ds) == 6
+
+    # backwards pairs (idx, idx-1): first frame of a scene is dropped
+    ids = sorted(str(m.sample_id) for _, _, _, _, m0 in [ds[i] for i in range(6)] for m in m0)
+    assert all("0001" not in s or True for s in ids)  # smoke: ids exist
+    _, _, _, _, meta = ds[0]
+    assert meta[0].sample_id.img2.kwargs["idx"] == meta[0].sample_id.img1.kwargs["idx"] - 1
+
+
+def test_dataset_file_filter(tmp_path):
+    _make_sintel_like(tmp_path)
+    # 6 samples in sorted key order; keep only token '1' entries
+    (tmp_path / "split.txt").write_text("1\n0\n1\n0\n1\n0\n")
+
+    cfg = {
+        "type": "dataset",
+        "spec": SPEC,
+        "parameters": {"pass": "clean"},
+        "filter": {"type": "file", "file": "split.txt", "value": "1"},
+    }
+    ds = data_config.load(tmp_path, cfg)
+    assert len(ds) == 3
+
+
+# -- combinators ------------------------------------------------------------
+
+
+class FakeSource(Collection):
+    type = "fake"
+
+    def __init__(self, n, h=6, w=8):
+        self.n, self.h, self.w = n, h, w
+
+    def __getitem__(self, index):
+        rng = np.random.RandomState(index)
+        img1 = rng.rand(1, self.h, self.w, 3).astype(np.float32)
+        img2 = rng.rand(1, self.h, self.w, 3).astype(np.float32)
+        flow = rng.randn(1, self.h, self.w, 2).astype(np.float32)
+        valid = np.ones((1, self.h, self.w), dtype=bool)
+        meta = [Metadata(True, "fake", SampleId("s{idx}", SampleArgs([], {"idx": index}),
+                                                SampleArgs([], {"idx": index + 1})),
+                         ((0, self.h), (0, self.w)))]
+        return img1, img2, flow, valid, meta
+
+    def __len__(self):
+        return self.n
+
+    def get_config(self):
+        return {"type": "fake", "n": self.n}
+
+    def description(self):
+        return "fake"
+
+
+def test_concat_repeat_subset():
+    a, b = FakeSource(3), FakeSource(2)
+
+    cat = combinators.Concat([a, b])
+    assert len(cat) == 5
+    assert cat[4] is not None
+
+    rep = combinators.Repeat(3, a)
+    assert len(rep) == 9
+    np.testing.assert_array_equal(rep[0][0], rep[3][0])
+    with pytest.raises(IndexError):
+        rep[9]
+
+    sub = combinators.Subset(4, a)
+    assert len(sub) == 4
+
+
+# -- augmentations ----------------------------------------------------------
+
+
+def _sample(h=16, w=20):
+    return FakeSource(1, h, w)[0]
+
+
+def test_crop():
+    aug = augment.Crop([10, 8])  # (w, h)
+    img1, img2, flow, valid, meta = aug(*_sample())
+    assert img1.shape == (1, 8, 10, 3)
+    assert flow.shape == (1, 8, 10, 2)
+    assert meta[0].original_extents == ((0, 8), (0, 10))
+
+
+def test_crop_center():
+    aug = augment.CropCenter([10, 8])
+    img1, *_ = aug(*_sample())
+    assert img1.shape == (1, 8, 10, 3)
+
+
+def test_flip_horizontal_flow_sign():
+    img1, img2, flow, valid, meta = _sample()
+    aug = augment.Flip([1.0, 0.0])  # always horizontal, never vertical
+    f1, f2, fl, v, m = aug(img1, img2, flow, valid, meta)
+    np.testing.assert_allclose(fl[:, :, :, 0], -flow[:, :, ::-1, 0])
+    np.testing.assert_allclose(fl[:, :, :, 1], flow[:, :, ::-1, 1])
+    np.testing.assert_allclose(f1, img1[:, :, ::-1])
+
+
+def test_flip_vertical_flow_sign():
+    img1, img2, flow, valid, meta = _sample()
+    aug = augment.Flip([0.0, 1.0])
+    _, _, fl, _, _ = aug(img1, img2, flow, valid, meta)
+    np.testing.assert_allclose(fl[:, :, :, 1], -flow[:, ::-1, :, 1])
+
+
+def test_occlusion_forward_only_touches_img2():
+    img1, img2, flow, valid, meta = _sample()
+    aug = augment.OcclusionForward(1.0, [3, 3], [4, 4], [8, 8])
+    f1, f2, *_ = aug(img1.copy(), img2.copy(), flow, valid, meta)
+    np.testing.assert_array_equal(f1, img1)
+    assert not np.array_equal(f2, img2)
+
+
+def test_restrict_flow_magnitude():
+    img1, img2, flow, valid, meta = _sample()
+    flow = flow * 0 + np.array([3.0, 4.0])  # magnitude 5 everywhere
+    aug = augment.RestrictFlowMagnitude(4.0)
+    _, _, _, v, _ = aug(img1, img2, flow, valid, meta)
+    assert not v.any()
+
+
+def test_scale_dense():
+    img1, img2, flow, valid, meta = _sample(16, 20)
+    aug = augment.Scale([0, 0], 2.0, 2.0, 0.0, 0.0, "linear", th_valid=0.99)
+    f1, f2, fl, v, m = aug(img1, img2, flow, valid, meta)
+    assert f1.shape == (1, 32, 40, 3)
+    assert fl.shape == (1, 32, 40, 2)
+    # flow vectors double with the resolution
+    np.testing.assert_allclose(fl[0, 0, 0], flow[0, 0, 0] * 2.0, rtol=1e-4)
+
+
+def test_scale_sparse_rescatters():
+    img1, img2, flow, valid, meta = _sample(16, 20)
+    valid = np.zeros_like(valid)
+    valid[0, 4, 5] = True
+    aug = augment.ScaleSparse([0, 0], 2.0, 2.0, 0.0, 0.0, "linear")
+    _, _, fl, v, _ = aug(img1, img2, flow, valid, meta)
+    assert v.sum() == 1
+    assert v[0, 8, 10]
+    np.testing.assert_allclose(fl[0, 8, 10], flow[0, 4, 5] * 2.0, rtol=1e-5)
+
+
+def test_translate_adds_offset():
+    img1, img2, flow, valid, meta = _sample(16, 20)
+    aug = augment.Translate([10, 10], [3, 3])
+    f1, f2, fl, v, _ = aug(img1, img2, flow, valid, meta)
+    assert f1.shape == f2.shape
+    assert f1.shape[1] >= 10 and f1.shape[2] >= 10
+
+
+def test_color_jitter_stays_in_range():
+    img1, img2, flow, valid, meta = _sample()
+    aug = augment.ColorJitter(0.5, 0.4, 0.4, 0.4, 0.16)
+    f1, f2, *_ = aug(img1, img2, flow, valid, meta)
+    assert f1.min() >= 0.0 and f1.max() <= 1.0
+    assert f1.shape == img1.shape
+    assert f1.dtype == np.float32
+
+
+def test_color_jitter_8bit_quantizes():
+    img1, img2, flow, valid, meta = _sample()
+    aug = augment.ColorJitter8bit(0.0, 0.0, 0.0, 0.0, 0.0)
+    f1, *_ = aug(img1, img2, flow, valid, meta)
+    np.testing.assert_allclose(f1, np.round(img1 * 255) / 255, atol=1e-6)
+
+
+def test_augment_collection_roundtrip():
+    src = FakeSource(2, h=16, w=20)
+    aug = augment.Augment([augment.Crop([10, 8])], src, sync=True)
+    img1, img2, flow, valid, meta = aug[0]
+    assert img1.shape == (1, 8, 10, 3)
+    cfg = aug.get_config()
+    assert cfg["type"] == "augment"
+    assert cfg["augmentations"][0]["type"] == "crop"
+
+
+# -- fw/bw ------------------------------------------------------------------
+
+
+def test_backwards_flow_constant_translation():
+    h, w = 20, 24
+    rng = np.random.RandomState(0)
+    img = rng.rand(h, w, 3).astype(np.float32)
+
+    # frame 2 is frame 1 shifted right by 3 pixels
+    img2 = np.roll(img, 3, axis=1)
+    flow = np.zeros((h, w, 2), dtype=np.float32)
+    flow[..., 0] = 3.0
+    valid = np.ones((h, w), dtype=bool)
+
+    flow_bw, valid_bw = fw_bw.estimate_backwards_flow_sparse(img, img2, flow, valid)
+
+    # interior pixels: backward flow is exactly -forward flow
+    assert valid_bw[:, 4:].all()
+    np.testing.assert_allclose(flow_bw[:, 4:, 0], -3.0, atol=1e-6)
+    np.testing.assert_allclose(flow_bw[:, 4:, 1], 0.0, atol=1e-6)
+    # disoccluded strip on the left receives no splats
+    assert not valid_bw[:, :3].any()
+
+
+def test_fill_min_densifies():
+    flow = np.zeros((8, 8, 2))
+    flow[..., 0] = 5.0
+    valid = np.zeros((8, 8), dtype=bool)
+    valid[4, 4] = True
+
+    out, v = fw_bw.fill_min(flow, valid)
+    assert v.all()
+    np.testing.assert_allclose(out[..., 0], 5.0)
+
+
+def test_fill_avg_densifies():
+    flow = np.zeros((8, 8, 2))
+    flow[..., 1] = -2.0
+    valid = np.zeros((8, 8), dtype=bool)
+    valid[2:6, 2:6] = True
+
+    out, v = fw_bw.fill_avg(flow, valid, threshold=1)
+    assert v.all()
+    np.testing.assert_allclose(out[..., 1], -2.0)
+
+
+def test_fw_bw_batch_pairs():
+    fwd, bwd = FakeSource(3), FakeSource(3)
+
+    # fake sources produce matching ids only if we swap img1/img2 args; build
+    # a wrapper for the backward side instead
+    class Bwd(FakeSource):
+        def __getitem__(self, index):
+            img1, img2, flow, valid, meta = super().__getitem__(index)
+            m = meta[0]
+            sid = SampleId(m.sample_id.format, m.sample_id.img2, m.sample_id.img1)
+            meta = [Metadata(m.valid, m.dataset_id, sid, m.original_extents)]
+            return img2, img1, -flow, valid, meta
+
+    src = fw_bw.ForwardsBackwardsBatch(fwd, Bwd(3))
+    img1, img2, flow, valid, meta = src[1]
+    assert img1.shape[0] == 2
+    assert meta[0].direction == "forwards"
+    assert meta[1].direction == "backwards"
