@@ -1,0 +1,3 @@
+from . import blocks, encoders, grid, hsup, norm, warp
+
+__all__ = ["blocks", "encoders", "grid", "hsup", "norm", "warp"]

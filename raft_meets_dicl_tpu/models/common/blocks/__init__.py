@@ -1,0 +1,3 @@
+from . import dicl, raft
+
+__all__ = ["dicl", "raft"]

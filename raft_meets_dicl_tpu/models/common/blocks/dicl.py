@@ -1,0 +1,161 @@
+"""DICL / GA-Net building blocks (Flax, NHWC).
+
+Behavioral equivalents of the reference blocks (src/models/common/blocks/
+dicl.py): conv blocks, GA-Net 2x up/down fusion blocks, the per-displacement
+MatchingNet, and the displacement-aware projection (DAP).
+
+TPU-native layout decisions:
+- Matching volumes are ``(B, du, dv, H, W, C)``; MatchingNet folds the
+  displacement axes into the batch so XLA sees one big conv over
+  ``B*du*dv`` maps (the reference does the same reshape trick with NCHW,
+  dicl.py:93-118).
+- Cost volumes are ``(B, H, W, du, dv)``; DAP flattens (du, dv) into the
+  trailing channel axis, making it a plain 1x1 conv — the ideal layout for
+  the TPU MXU (channels-last matmul over du*dv).
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..norm import Norm2d
+from ..util import identity_1x1_init
+
+
+class ConvBlock(nn.Module):
+    """conv → norm → relu (no conv bias, like the reference)."""
+
+    c_out: int
+    kernel_size: int = 3
+    stride: int = 1
+    dilation: int = 1
+    norm_type: str = "batch"
+    num_groups: int = 8
+
+    @nn.compact
+    def __call__(self, x, train=False, frozen_bn=False):
+        x = nn.Conv(
+            self.c_out,
+            (self.kernel_size, self.kernel_size),
+            strides=self.stride,
+            kernel_dilation=self.dilation,
+            use_bias=False,
+        )(x)
+        x = Norm2d(self.norm_type, self.num_groups)(x, train and not frozen_bn)
+        return nn.relu(x)
+
+
+class ConvBlockTransposed(nn.Module):
+    """transposed conv (2x up, k=4 s=2 p=1 torch geometry) → norm → relu."""
+
+    c_out: int
+    norm_type: str = "batch"
+    num_groups: int = 8
+
+    @nn.compact
+    def __call__(self, x, train=False, frozen_bn=False):
+        x = nn.ConvTranspose(
+            self.c_out, (4, 4), strides=(2, 2), padding=((1, 1), (1, 1)),
+            use_bias=False,
+        )(x)
+        x = Norm2d(self.norm_type, self.num_groups)(x, train and not frozen_bn)
+        return nn.relu(x)
+
+
+class GaConv2xBlock(nn.Module):
+    """Strided 3x3 downsample fused with a same-resolution skip input."""
+
+    c_out: int
+    norm_type: str = "batch"
+
+    @nn.compact
+    def __call__(self, x, res, train=False, frozen_bn=False):
+        x = nn.Conv(self.c_out, (3, 3), strides=2, use_bias=False)(x)
+        x = nn.relu(x)
+
+        assert x.shape == res.shape
+        x = jnp.concatenate((x, res), axis=-1)
+
+        x = nn.Conv(self.c_out, (3, 3), use_bias=False)(x)
+        x = Norm2d(self.norm_type, 8)(x, train and not frozen_bn)
+        return nn.relu(x)
+
+
+class GaConv2xBlockTransposed(nn.Module):
+    """2x transposed-conv upsample fused with a same-resolution skip input."""
+
+    c_out: int
+    norm_type: str = "batch"
+
+    @nn.compact
+    def __call__(self, x, res, train=False, frozen_bn=False):
+        x = nn.ConvTranspose(
+            self.c_out, (4, 4), strides=(2, 2), padding=((1, 1), (1, 1)),
+            use_bias=False,
+        )(x)
+        x = nn.relu(x)
+
+        assert x.shape == res.shape
+        x = jnp.concatenate((x, res), axis=-1)
+
+        x = nn.Conv(self.c_out, (3, 3), use_bias=False)(x)
+        x = Norm2d(self.norm_type, 8)(x, train and not frozen_bn)
+        return nn.relu(x)
+
+
+class MatchingNet(nn.Module):
+    """6-layer conv hourglass applied per displacement candidate.
+
+    Input ``(B, du, dv, H, W, C)`` (stacked feature pairs), output cost
+    ``(B, H, W, du, dv)``. The displacement axes ride the batch dimension
+    through the convs — one large batched conv instead of du*dv small ones.
+    """
+
+    norm_type: str = "batch"
+    scale: float = 1
+
+    @nn.compact
+    def __call__(self, mvol, train=False, frozen_bn=False):
+        b, du, dv, h, w, c = mvol.shape
+        c1 = int(self.scale * 96)
+        c2 = int(self.scale * 128)
+        c3 = int(self.scale * 64)
+        c4 = int(self.scale * 32)
+
+        x = mvol.reshape(b * du * dv, h, w, c)
+
+        x = ConvBlock(c1, norm_type=self.norm_type)(x, train, frozen_bn)
+        x = ConvBlock(c2, stride=2, norm_type=self.norm_type)(x, train, frozen_bn)
+        x = ConvBlock(c2, norm_type=self.norm_type)(x, train, frozen_bn)
+        x = ConvBlock(c3, norm_type=self.norm_type)(x, train, frozen_bn)
+        x = ConvBlockTransposed(c4, norm_type=self.norm_type, num_groups=4)(x, train, frozen_bn)
+        x = nn.Conv(1, (3, 3))(x)  # with bias, like the reference
+
+        cost = x.reshape(b, du, dv, h, w)
+        return cost.transpose(0, 3, 4, 1, 2)  # (B, H, W, du, dv)
+
+
+class DisplacementAwareProjection(nn.Module):
+    """1x1 conv mixing the du*dv displacement channels of a cost volume.
+
+    Input/output ``(B, H, W, du, dv)``. ``init='identity'`` starts as a
+    no-op projection (reference dicl.py:121-150).
+    """
+
+    disp_range: tuple
+    init: str = "identity"
+
+    @nn.compact
+    def __call__(self, x):
+        if self.init not in ("identity", "standard"):
+            raise ValueError(f"unknown init value '{self.init}'")
+
+        b, h, w, du, dv = x.shape
+        assert (du, dv) == (2 * self.disp_range[0] + 1, 2 * self.disp_range[1] + 1)
+
+        kernel_init = (
+            identity_1x1_init if self.init == "identity" else nn.initializers.lecun_normal()
+        )
+
+        x = x.reshape(b, h, w, du * dv)
+        x = nn.Conv(du * dv, (1, 1), use_bias=False, kernel_init=kernel_init)(x)
+        return x.reshape(b, h, w, du, dv)

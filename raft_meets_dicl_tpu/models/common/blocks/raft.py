@@ -1,0 +1,40 @@
+"""RAFT encoder building blocks (Flax, NHWC).
+
+Behavioral equivalent of the reference blocks (src/models/common/blocks/
+raft.py:13-46) with kaiming-normal conv init like the reference encoders.
+"""
+
+import flax.linen as nn
+
+from ..norm import Norm2d
+
+kaiming_normal = nn.initializers.variance_scaling(2.0, "fan_out", "normal")
+
+
+class ResidualBlock(nn.Module):
+    """Two 3x3 convs with norm + residual; strided 1x1 downsample path."""
+
+    out_planes: int
+    norm_type: str = "group"
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x, train=False, frozen_bn=False):
+        groups = self.out_planes // 8
+        norm_train = train and not frozen_bn
+
+        y = nn.Conv(self.out_planes, (3, 3), strides=self.stride,
+                    kernel_init=kaiming_normal)(x)
+        y = Norm2d(self.norm_type, groups)(y, norm_train)
+        y = nn.relu(y)
+
+        y = nn.Conv(self.out_planes, (3, 3), kernel_init=kaiming_normal)(y)
+        y = Norm2d(self.norm_type, groups)(y, norm_train)
+        y = nn.relu(y)
+
+        if self.stride > 1:
+            x = nn.Conv(self.out_planes, (1, 1), strides=self.stride,
+                        kernel_init=kaiming_normal)(x)
+            x = Norm2d(self.norm_type, groups)(x, norm_train)
+
+        return nn.relu(x + y)

@@ -1,0 +1,53 @@
+"""Encoder factories: family × output shape.
+
+Mirrors the reference factory surface (src/models/common/encoders/
+__init__.py:7-61): families ``raft``, ``dicl``, ``raft-avgpool``,
+``raft-maxpool``, ``rfpm-raft`` over shapes ``s3`` (single-scale 1/8) and
+``p34``/``p35``/``p36`` (pyramids 1/8..1/16, 1/8..1/32, 1/8..1/64).
+Families are filled in as the model zoo grows; unknown names raise.
+"""
+
+from . import raft
+
+# families are registered here as their modules get built
+_S3_FAMILIES = {"raft": lambda: raft.FeatureEncoderS3}
+_PYRAMID_FAMILIES = {"raft": lambda: raft.FeatureEncoderPyramid}
+
+_KNOWN_FAMILIES = ("raft", "raft-avgpool", "raft-maxpool", "dicl", "rfpm-raft")
+
+
+def _resolve(families, encoder_type):
+    if encoder_type in families:
+        return families[encoder_type]()
+    if encoder_type in _KNOWN_FAMILIES:
+        raise NotImplementedError(
+            f"encoder family '{encoder_type}' is not implemented yet"
+        )
+    raise ValueError(f"unsupported feature encoder type: '{encoder_type}'")
+
+
+def make_encoder_s3(encoder_type, output_dim, norm_type, dropout, **kwargs):
+    cls = _resolve(_S3_FAMILIES, encoder_type)
+    return cls(output_dim=output_dim, norm_type=norm_type, dropout=dropout, **kwargs)
+
+
+def _make_pyramid(encoder_type, levels, output_dim, norm_type, dropout, **kwargs):
+    if encoder_type in ("raft-avgpool", "raft-maxpool"):
+        kwargs = {"pool_type": encoder_type.removeprefix("raft-")[:-4], **kwargs}
+    cls = _resolve(_PYRAMID_FAMILIES, encoder_type)
+    return cls(
+        output_dim=output_dim, levels=levels, norm_type=norm_type,
+        dropout=dropout, **kwargs
+    )
+
+
+def make_encoder_p34(encoder_type, output_dim, norm_type, dropout, **kwargs):
+    return _make_pyramid(encoder_type, 2, output_dim, norm_type, dropout, **kwargs)
+
+
+def make_encoder_p35(encoder_type, output_dim, norm_type, dropout, **kwargs):
+    return _make_pyramid(encoder_type, 3, output_dim, norm_type, dropout, **kwargs)
+
+
+def make_encoder_p36(encoder_type, output_dim, norm_type, dropout, **kwargs):
+    return _make_pyramid(encoder_type, 4, output_dim, norm_type, dropout, **kwargs)

@@ -1,0 +1,133 @@
+"""RAFT feature/context encoders (Flax, NHWC).
+
+Single-scale s3 (1/8 resolution) after the reference
+(src/models/common/encoders/raft/s3.py): 7x7 stride-2 input conv, three
+residual stages (64/96/128), 1x1 output conv, optional 2D dropout.
+
+The reference's shared-batch trick for image pairs (s3.py:53-57) is kept:
+pass a tuple ``(img1, img2)`` and both are encoded in one batched pass.
+
+Pyramid variants (p34/p35/p36) extend the residual stack with 160/192
+channel stages and per-level output heads (reference raft/p36.py,
+raft/common.py) returning features at 1/8..1/64.
+"""
+
+from typing import Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..blocks.raft import ResidualBlock, kaiming_normal
+from ..norm import Norm2d
+
+
+class _Stem(nn.Module):
+    """Input conv + the first three residual stages (to 1/8, 128ch)."""
+
+    norm_type: str = "instance"
+
+    @nn.compact
+    def __call__(self, x, train=False, frozen_bn=False):
+        x = nn.Conv(64, (7, 7), strides=2, padding=3, kernel_init=kaiming_normal)(x)
+        x = Norm2d(self.norm_type, 8)(x, train and not frozen_bn)
+        x = nn.relu(x)
+
+        x = ResidualBlock(64, self.norm_type, stride=1)(x, train, frozen_bn)
+        x = ResidualBlock(64, self.norm_type, stride=1)(x, train, frozen_bn)
+
+        x = ResidualBlock(96, self.norm_type, stride=2)(x, train, frozen_bn)
+        x = ResidualBlock(96, self.norm_type, stride=1)(x, train, frozen_bn)
+
+        x = ResidualBlock(128, self.norm_type, stride=2)(x, train, frozen_bn)
+        x = ResidualBlock(128, self.norm_type, stride=1)(x, train, frozen_bn)
+
+        return x
+
+
+def _drop2d(x, rate, train):
+    """Channel dropout (torch Dropout2d): broadcast over spatial dims."""
+    return nn.Dropout(rate, broadcast_dims=(1, 2), deterministic=not train)(x)
+
+
+class FeatureEncoderS3(nn.Module):
+    """Single-scale encoder: (B, H, W, 3) → (B, H/8, W/8, output_dim)."""
+
+    output_dim: int = 128
+    norm_type: str = "instance"
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train=False, frozen_bn=False):
+        paired = isinstance(x, (tuple, list))
+        if paired:
+            n = x[0].shape[0]
+            x = jnp.concatenate(x, axis=0)
+
+        x = _Stem(self.norm_type)(x, train, frozen_bn)
+        x = nn.Conv(self.output_dim, (1, 1), kernel_init=kaiming_normal)(x)
+        if self.dropout > 0:
+            x = _drop2d(x, self.dropout, train)
+
+        if paired:
+            return x[:n], x[n:]
+        return x
+
+
+class EncoderOutputNet(nn.Module):
+    """Per-level output head: 3x3 conv + norm + relu + 1x1 conv
+    (reference raft/common.py:6-29)."""
+
+    output_dim: int
+    intermediate_dim: int = 128
+    norm_type: str = "batch"
+
+    @nn.compact
+    def __call__(self, x, train=False, frozen_bn=False):
+        x = nn.Conv(self.intermediate_dim, (3, 3), kernel_init=kaiming_normal)(x)
+        x = Norm2d(self.norm_type, 8)(x, train and not frozen_bn)
+        x = nn.relu(x)
+        x = nn.Conv(self.output_dim, (1, 1), kernel_init=kaiming_normal)(x)
+        return x
+
+
+class FeatureEncoderPyramid(nn.Module):
+    """Pyramid encoder returning features at 1/8 .. 1/(8*2^(levels-1)).
+
+    ``levels=2`` ≈ reference p34 (1/8, 1/16), ``3`` ≈ p35, ``4`` ≈ p36.
+    Extra residual stages use 160/192/224 channels like the reference
+    (raft/p36.py:9-61); each level gets its own output head.
+    """
+
+    output_dim: int = 128
+    levels: int = 3
+    norm_type: str = "instance"
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train=False, frozen_bn=False) -> Tuple:
+        paired = isinstance(x, (tuple, list))
+        if paired:
+            n = x[0].shape[0]
+            x = jnp.concatenate(x, axis=0)
+
+        x = _Stem(self.norm_type)(x, train, frozen_bn)  # 1/8, 128ch
+
+        stage_channels = (160, 192, 224)
+        outputs = []
+        for i in range(self.levels):
+            out = EncoderOutputNet(self.output_dim, norm_type=self.norm_type)(x, train, frozen_bn)
+            if self.dropout > 0:
+                out = _drop2d(out, self.dropout, train)
+            outputs.append(out)
+
+            if i + 1 < self.levels:
+                ch = stage_channels[min(i, len(stage_channels) - 1)]
+                x = ResidualBlock(ch, self.norm_type, stride=2)(x, train, frozen_bn)
+                x = ResidualBlock(ch, self.norm_type, stride=1)(x, train, frozen_bn)
+
+        if paired:
+            return (
+                tuple(o[:n] for o in outputs),
+                tuple(o[n:] for o in outputs),
+            )
+        return tuple(outputs)

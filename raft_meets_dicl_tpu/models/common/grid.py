@@ -1,0 +1,17 @@
+"""Coordinate grids, NHWC layout.
+
+Reference returns (B, 2, H, W) (src/models/common/grid.py:4-12); here grids
+are (B, H, W, 2) with channel 0 = x, 1 = y — the TPU-native
+channels-last convention used across this framework.
+"""
+
+import jax.numpy as jnp
+
+
+def coordinate_grid(batch, h, w, dtype=jnp.float32):
+    """(B, H, W, 2) pixel-position grid; [..., 0] = x, [..., 1] = y."""
+    ys, xs = jnp.meshgrid(
+        jnp.arange(h, dtype=dtype), jnp.arange(w, dtype=dtype), indexing="ij"
+    )
+    grid = jnp.stack((xs, ys), axis=-1)
+    return jnp.broadcast_to(grid, (batch, h, w, 2))

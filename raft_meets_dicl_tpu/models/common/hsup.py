@@ -1,0 +1,111 @@
+"""Hidden-state upsamplers between coarse-to-fine pyramid levels.
+
+Reference: src/models/common/hsup.py — carries the GRU hidden state from a
+coarse level into the next finer level's initialization. Three variants:
+``none`` (use the fine init), ``bilinear`` (identity-init 1x1 conv +
+bilinear 2x + add), ``crossattn`` (3x3-window cross-attention with Q from
+the fine init and K/V unfolded from the coarse state).
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .util import identity_1x1_init
+
+
+def upsample2d_bilinear(x, size):
+    """align_corners=True bilinear resize to ``size`` = (H, W), NHWC."""
+    b, h, w, c = x.shape
+    nh, nw = size
+
+    ys = jnp.linspace(0.0, h - 1.0, nh)
+    xs = jnp.linspace(0.0, w - 1.0, nw)
+
+    y0 = jnp.floor(ys).astype(jnp.int32)
+    x0 = jnp.floor(xs).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, h - 1)
+    x1 = jnp.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[None, :, None, None]
+    wx = (xs - x0)[None, None, :, None]
+
+    top = x[:, y0][:, :, x0] * (1 - wx) + x[:, y0][:, :, x1] * wx
+    bot = x[:, y1][:, :, x0] * (1 - wx) + x[:, y1][:, :, x1] * wx
+    return top * (1 - wy) + bot * wy
+
+
+class HUpNone(nn.Module):
+    recurrent_channels: int
+
+    def __call__(self, h_prev, h_init):
+        return h_init
+
+
+class HUpBilinear(nn.Module):
+    """Identity-init 1x1 conv on the coarse state, 2x bilinear, add."""
+
+    recurrent_channels: int
+
+    @nn.compact
+    def __call__(self, h_prev, h_init):
+        b, h, w, c = h_init.shape
+
+        h_prev = nn.Conv(self.recurrent_channels, (1, 1),
+                         kernel_init=identity_1x1_init)(h_prev)
+        h_prev = upsample2d_bilinear(h_prev, (h, w))
+
+        return h_init + h_prev
+
+
+class HUpCrossAttn(nn.Module):
+    """Local 3x3-window cross-attention from fine init to coarse state."""
+
+    recurrent_channels: int
+    key_channels: int = 64
+
+    @nn.compact
+    def __call__(self, h_prev, h_init):
+        b, h, w, _ = h_init.shape
+        _, h2, w2, _ = h_prev.shape
+        ck, cv = self.key_channels, self.recurrent_channels
+
+        q = nn.Conv(ck, (1, 1))(h_init)       # (B, h, w, ck)
+        k = nn.Conv(ck, (1, 1))(h_prev)       # (B, h2, w2, ck)
+        v = nn.Conv(cv, (1, 1))(h_prev)       # (B, h2, w2, cv)
+
+        def unfold3x3(t):
+            # (B, h2, w2, 9, C): zero-padded 3x3 neighborhoods
+            t = jnp.pad(t, ((0, 0), (1, 1), (1, 1), (0, 0)))
+            patches = [
+                t[:, dy : dy + h2, dx : dx + w2]
+                for dy in range(3)
+                for dx in range(3)
+            ]
+            return jnp.stack(patches, axis=3)
+
+        def expand_to_fine(t):
+            # nearest-repeat each coarse cell onto its fine-level block
+            ry, rx = h // h2, w // w2
+            t = jnp.repeat(t, ry, axis=1)
+            return jnp.repeat(t, rx, axis=2)
+
+        k_win = expand_to_fine(unfold3x3(k))  # (B, h, w, 9, ck)
+        v_win = expand_to_fine(unfold3x3(v))  # (B, h, w, 9, cv)
+
+        attn = jnp.einsum("bhwc,bhwkc->bhwk", q, k_win)
+        attn = jax.nn.softmax(attn, axis=-1)
+
+        x = jnp.einsum("bhwk,bhwkc->bhwc", attn, v_win)
+
+        v_init = nn.Conv(cv, (1, 1))(h_init)
+        return nn.Conv(self.recurrent_channels, (1, 1))(v_init + x)
+
+
+def make_hidden_state_upsampler(type, recurrent_channels):
+    if type == "none":
+        return HUpNone(recurrent_channels)
+    if type == "bilinear":
+        return HUpBilinear(recurrent_channels)
+    if type == "crossattn":
+        return HUpCrossAttn(recurrent_channels)
+    raise ValueError(f"unknown hidden state upsampler type '{type}'")

@@ -1,0 +1,49 @@
+"""2D normalization with a string-typed factory, Flax edition.
+
+Mirrors the reference factory (src/models/common/norm.py:4-16) with torch
+hyperparameters (eps 1e-5, BN momentum 0.1 → flax momentum 0.9; instance
+norm non-affine). Batchnorm freezing is not implemented by module surgery
+like the reference (norm.py:18-32) — it's an apply-time switch: the model
+wrapper passes ``train=False``-equivalent ``use_running_average`` into
+``Norm2d.__call__`` (see models/model.py ``Model.apply``).
+"""
+
+import flax.linen as nn
+
+NORM_TYPES = ("group", "batch", "instance", "none")
+
+
+class Norm2d(nn.Module):
+    """Dispatches to group/batch/instance/no normalization over NHWC maps.
+
+    ``train`` only affects batch norm (running-stats update vs. use).
+    """
+
+    ty: str
+    num_groups: int = 8
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        if self.ty == "group":
+            return nn.GroupNorm(num_groups=self.num_groups, epsilon=1e-5)(x)
+        if self.ty == "batch":
+            return nn.BatchNorm(
+                use_running_average=not train, momentum=0.9, epsilon=1e-5
+            )(x)
+        if self.ty == "instance":
+            # per-sample, per-channel over spatial dims; non-affine like torch
+            return nn.GroupNorm(
+                num_groups=None, group_size=1, epsilon=1e-5,
+                use_scale=False, use_bias=False,
+            )(x)
+        if self.ty == "none":
+            return x
+        raise ValueError(f"unknown norm type '{self.ty}'")
+
+
+def make_norm2d(ty, num_channels=None, num_groups=8):
+    """Factory matching the reference signature; ``num_channels`` is implied
+    by the input in flax and kept only for call-site compatibility."""
+    if ty not in NORM_TYPES:
+        raise ValueError(f"unknown norm type '{ty}'")
+    return Norm2d(ty=ty, num_groups=num_groups)

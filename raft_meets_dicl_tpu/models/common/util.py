@@ -1,0 +1,17 @@
+"""Small shared helpers for the NN layer."""
+
+import jax.numpy as jnp
+
+
+def unfold3x3(x):
+    """(B, H, W, C) → (B, H, W, 9, C) zero-padded 3x3 neighborhoods,
+    window ordered row-major (dy, dx) like torch ``F.unfold``."""
+    b, h, w, c = x.shape
+    x = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    patches = [x[:, dy : dy + h, dx : dx + w] for dy in range(3) for dx in range(3)]
+    return jnp.stack(patches, axis=3)
+
+
+def identity_1x1_init(key, shape, dtype=jnp.float32):
+    """(1, 1, C, C) identity kernel — identity-initialized 1x1 convs."""
+    return jnp.eye(shape[-1], dtype=dtype).reshape(shape)

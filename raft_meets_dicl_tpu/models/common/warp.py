@@ -1,0 +1,28 @@
+"""Backwards warping via flow, NHWC.
+
+Reference: src/models/common/warp.py:5-33 (grid_sample with
+align_corners=True + sampled validity mask). Built on the shared
+torch-parity bilinear gather in ops.sample.
+"""
+
+import jax.numpy as jnp
+
+from ...ops.sample import sample_bilinear
+from .grid import coordinate_grid
+
+
+def warp_backwards(img2, flow, eps=1e-5):
+    """Warp ``img2`` back to frame 1 by sampling at ``grid + flow``.
+
+    img2: (B, H, W, C); flow: (B, H, W, 2). Returns (est1, mask) where mask
+    is True for pixels whose sample window lies fully inside the image.
+    """
+    b, h, w, c = img2.shape
+
+    pos = coordinate_grid(b, h, w, dtype=flow.dtype) + flow
+    x, y = pos[..., 0], pos[..., 1]
+
+    est1 = sample_bilinear(img2, x, y)
+    mask = sample_bilinear(jnp.ones_like(img2), x, y) > (1.0 - eps)
+
+    return est1 * mask, mask

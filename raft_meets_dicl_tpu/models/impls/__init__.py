@@ -1,0 +1,5 @@
+"""Model zoo. Importing this package registers all model/loss types."""
+
+from . import raft
+
+__all__ = ["raft"]

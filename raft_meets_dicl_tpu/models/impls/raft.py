@@ -1,0 +1,497 @@
+"""RAFT baseline (``raft/baseline``), TPU-native.
+
+Re-design of the reference implementation (src/models/impls/raft.py, itself
+after Teed & Deng's RAFT) in Flax/JAX:
+
+- the all-pairs correlation volume + pyramid + windowed lookup live in
+  ``ops.corr`` (einsum on the MXU + vectorized gathers, raft.py:15-95),
+- the iterative GRU update loop is a single ``nn.scan`` over the
+  ``(hidden, coords)`` carry (raft.py:401-428's python loop) — one compiled
+  step body instead of an unrolled graph,
+- per-iteration gradient detaches (coords, flow input, optional corr) map
+  to ``lax.stop_gradient``,
+- layout is NHWC throughout; flow tensors are (B, H, W, 2) with
+  channel 0 = x.
+
+Static switches (``iterations``, ``upnet``, ``corr_flow``,
+``corr_grad_stop``, ``mask_costs``) are python-level arguments: changing
+them recompiles, matching the per-stage argument override model.
+"""
+
+from typing import Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ...ops.corr import (
+    all_pairs_correlation,
+    correlation_pyramid,
+    lookup_pyramid,
+    window_delta,
+)
+from .. import common
+from ..common.blocks.dicl import DisplacementAwareProjection
+from ..common.grid import coordinate_grid
+from ..common.hsup import upsample2d_bilinear
+from ..common.util import unfold3x3
+from ..config import register_loss, register_model
+from ..model import Loss, Model, ModelAdapter, Result
+
+
+class SoftArgMaxFlowRegression(nn.Module):
+    """Cost → flow readout: softmax-weighted displacement sum per level.
+
+    Input: lookup output (B, H, W, L*(2r+1)²), channels (level, dx, dy).
+    Returns a list of per-level flow deltas (B, H, W, 2), scaled 2^level.
+    """
+
+    num_levels: int
+    radius: int
+    temperature: float = 1.0
+    dap: bool = False
+
+    @nn.compact
+    def __call__(self, corr):
+        b, h, w, _ = corr.shape
+        k = 2 * self.radius + 1
+        delta = window_delta(self.radius, corr.dtype)
+
+        out = []
+        for lvl in range(self.num_levels):
+            score = corr[..., lvl * k * k : (lvl + 1) * k * k]
+
+            if self.dap:
+                score = score.reshape(b, h, w, k, k)
+                score = DisplacementAwareProjection((self.radius, self.radius))(score)
+                score = score.reshape(b, h, w, k * k)
+
+            score = jax.nn.softmax(score / self.temperature, axis=-1)
+            flow = jnp.einsum(
+                "bhwk,kc->bhwc", score, delta.reshape(k * k, 2) * 2**lvl
+            )
+            out.append(flow)
+
+        return out
+
+
+def make_flow_regression(type, num_levels, radius, **kwargs):
+    if type == "softargmax":
+        return SoftArgMaxFlowRegression(num_levels, radius, dap=False, **kwargs)
+    if type == "softargmax+dap":
+        return SoftArgMaxFlowRegression(num_levels, radius, dap=True, **kwargs)
+    raise ValueError(f"unknown correlation module type '{type}'")
+
+
+class BasicMotionEncoder(nn.Module):
+    """Combine correlation features and current flow into motion features."""
+
+    @nn.compact
+    def __call__(self, flow, corr):
+        cor = nn.relu(nn.Conv(256, (1, 1))(corr))
+        cor = nn.relu(nn.Conv(192, (3, 3))(cor))
+
+        flo = nn.relu(nn.Conv(128, (7, 7))(flow))
+        flo = nn.relu(nn.Conv(64, (3, 3))(flo))
+
+        combined = jnp.concatenate((cor, flo), axis=-1)
+        combined = nn.relu(nn.Conv(128 - 2, (3, 3))(combined))
+
+        return jnp.concatenate((combined, flow), axis=-1)  # 128 channels
+
+
+class SepConvGru(nn.Module):
+    """Separable (1x5 then 5x1) convolutional GRU."""
+
+    hidden_dim: int = 128
+
+    @nn.compact
+    def __call__(self, h, x):
+        for ksize in ((1, 5), (5, 1)):
+            hx = jnp.concatenate((h, x), axis=-1)
+            z = nn.sigmoid(nn.Conv(self.hidden_dim, ksize)(hx))
+            r = nn.sigmoid(nn.Conv(self.hidden_dim, ksize)(hx))
+            q = jnp.tanh(
+                nn.Conv(self.hidden_dim, ksize)(jnp.concatenate((r * h, x), axis=-1))
+            )
+            h = (1.0 - z) * h + z * q
+
+        return h
+
+
+class FlowHead(nn.Module):
+    """Hidden state → delta flow."""
+
+    hidden_dim: int = 256
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(nn.Conv(self.hidden_dim, (3, 3))(x))
+        return nn.Conv(2, (3, 3))(x)
+
+
+class BasicUpdateBlock(nn.Module):
+    """One recurrent update: motion encoding + GRU + flow head."""
+
+    hidden_dim: int = 128
+
+    @nn.compact
+    def __call__(self, h, x, corr, flow):
+        m = BasicMotionEncoder()(flow, corr)
+        x = jnp.concatenate((x, m), axis=-1)
+
+        h = SepConvGru(self.hidden_dim)(h, x)
+        d = FlowHead(256)(h)
+
+        return h, d
+
+
+class Up8Network(nn.Module):
+    """Convex 8x upsampling: per-pixel softmax over 3x3 coarse neighbors."""
+
+    temperature: float = 4.0  # 4.0 = 1.0/0.25 in original RAFT
+
+    @nn.compact
+    def __call__(self, hidden, flow):
+        b, h, w, c = flow.shape
+
+        mask = nn.Conv(256, (3, 3))(hidden)
+        mask = nn.relu(mask)
+        mask = nn.Conv(8 * 8 * 9, (1, 1))(mask)
+        mask = mask.reshape(b, h, w, 9, 8, 8)
+        mask = jax.nn.softmax(mask / self.temperature, axis=3)
+
+        win = unfold3x3(8.0 * flow)  # (B, h, w, 9, 2)
+
+        up = jnp.einsum("bhwkij,bhwkc->bhiwjc", mask, win)
+        return up.reshape(b, h * 8, w * 8, c)
+
+
+class _RaftStep(nn.Module):
+    """One GRU iteration — the nn.scan body.
+
+    Carry is (hidden, coords1); broadcast inputs are the correlation
+    pyramid, context features, and the coords0 grid. Produces the
+    upsampled flow (and optional corr-flow readouts) per iteration.
+    """
+
+    corr_levels: int
+    corr_radius: int
+    recurrent_channels: int
+    upnet: bool
+    corr_flow: bool
+    corr_grad_stop: bool
+    mask_costs: Tuple[int, ...]
+    corr_reg_type: str
+    corr_reg_args: dict
+    full_shape: Tuple[int, int]
+
+    @nn.compact
+    def __call__(self, carry, pyramid, x, coords0):
+        h, coords1 = carry
+        coords1 = jax.lax.stop_gradient(coords1)
+        flow = coords1 - coords0
+
+        corr = lookup_pyramid(pyramid, coords1, self.corr_radius, self.mask_costs)
+
+        # always *call* the readout so its params exist regardless of the
+        # static switch (per-stage overrides / checkpoint compatibility);
+        # XLA dead-code-eliminates the unused branch
+        reg = make_flow_regression(
+            self.corr_reg_type, self.corr_levels, self.corr_radius,
+            **self.corr_reg_args,
+        )
+        corr_flows = tuple(flow + d for d in reg(corr))
+        if not self.corr_flow:
+            corr_flows = ()
+
+        if self.corr_grad_stop:
+            corr = jax.lax.stop_gradient(corr)
+
+        h, d = BasicUpdateBlock(self.recurrent_channels)(h, x, corr, flow)
+
+        coords1 = coords1 + d
+        flow = coords1 - coords0
+
+        # same always-call rule for the upsampling network
+        flow_up_net = Up8Network()(h, flow)
+        if self.upnet:
+            flow_up = flow_up_net
+        else:
+            flow_up = 8.0 * upsample2d_bilinear(flow, self.full_shape)
+
+        return (h, coords1), (flow_up, corr_flows)
+
+
+class RaftModule(nn.Module):
+    """RAFT flow estimation network (reference RaftModule, raft.py:334-433)."""
+
+    dropout: float = 0.0
+    mixed_precision: bool = False
+    corr_levels: int = 4
+    corr_radius: int = 4
+    corr_channels: int = 256
+    context_channels: int = 128
+    recurrent_channels: int = 128
+    encoder_norm: str = "instance"
+    context_norm: str = "batch"
+    encoder_type: str = "raft"
+    context_type: str = "raft"
+    corr_reg_type: str = "softargmax"
+    corr_reg_args: dict = None
+
+    @nn.compact
+    def __call__(self, img1, img2, train=False, frozen_bn=False, iterations=12,
+                 flow_init=None, upnet=True, corr_flow=False,
+                 corr_grad_stop=False, mask_costs=()):
+        hdim = self.recurrent_channels
+        cdim = self.context_channels
+        reg_args = self.corr_reg_args or {}
+
+        fnet = common.encoders.make_encoder_s3(
+            self.encoder_type, output_dim=self.corr_channels,
+            norm_type=self.encoder_norm, dropout=self.dropout,
+        )
+        cnet = common.encoders.make_encoder_s3(
+            self.context_type, output_dim=hdim + cdim,
+            norm_type=self.context_norm, dropout=self.dropout,
+        )
+
+        fmap1, fmap2 = fnet((img1, img2), train, frozen_bn)
+        fmap1 = fmap1.astype(jnp.float32)
+        fmap2 = fmap2.astype(jnp.float32)
+
+        pyramid = correlation_pyramid(
+            all_pairs_correlation(fmap1, fmap2), self.corr_levels
+        )
+
+        ctx = cnet(img1, train, frozen_bn)
+        h = jnp.tanh(ctx[..., :hdim])
+        x = nn.relu(ctx[..., hdim:])
+
+        b, hc, wc, _ = fmap1.shape
+        coords0 = coordinate_grid(b, hc, wc)
+        coords1 = coords0 + flow_init if flow_init is not None else coords0
+
+        step = nn.scan(
+            _RaftStep,
+            variable_broadcast="params",
+            split_rngs={"params": False, "dropout": True},
+            in_axes=nn.broadcast,
+            out_axes=0,
+            length=iterations,
+        )(
+            corr_levels=self.corr_levels,
+            corr_radius=self.corr_radius,
+            recurrent_channels=hdim,
+            upnet=upnet,
+            corr_flow=corr_flow,
+            corr_grad_stop=corr_grad_stop,
+            mask_costs=tuple(mask_costs),
+            corr_reg_type=self.corr_reg_type,
+            corr_reg_args=reg_args,
+            full_shape=(img1.shape[1], img1.shape[2]),
+        )
+
+        (h, coords1), (flows_up, corr_flows) = step(
+            (h, coords1), tuple(pyramid), x, coords0
+        )
+
+        # unstack the scan axis into per-iteration lists (protocol parity)
+        out = [flows_up[i] for i in range(iterations)]
+
+        if corr_flow:
+            # corr_flows is a tuple over levels of (iterations, B, H, W, 2);
+            # return coarse-to-fine level lists, then the final sequence
+            per_level = [
+                [corr_flows[lvl][i] for i in range(iterations)]
+                for lvl in range(self.corr_levels)
+            ]
+            return (*reversed(per_level), out)
+
+        return out
+
+
+@register_model
+class Raft(Model):
+    """Config wrapper for ``raft/baseline`` (reference raft.py:436-559)."""
+
+    type = "raft/baseline"
+
+    @classmethod
+    def from_config(cls, cfg):
+        cls._typecheck(cfg)
+
+        param_cfg = cfg["parameters"]
+        return cls(
+            dropout=float(param_cfg.get("dropout", 0.0)),
+            mixed_precision=bool(param_cfg.get("mixed-precision", False)),
+            corr_levels=param_cfg.get("corr-levels", 4),
+            corr_radius=param_cfg.get("corr-radius", 4),
+            corr_channels=param_cfg.get("corr-channels", 256),
+            context_channels=param_cfg.get("context-channels", 128),
+            recurrent_channels=param_cfg.get("recurrent-channels", 128),
+            encoder_norm=param_cfg.get("encoder-norm", "instance"),
+            context_norm=param_cfg.get("context-norm", "batch"),
+            encoder_type=param_cfg.get("encoder-type", "raft"),
+            context_type=param_cfg.get("context-type", "raft"),
+            corr_reg_type=param_cfg.get("corr-reg-type", "softargmax"),
+            corr_reg_args=param_cfg.get("corr-reg-args", {}),
+            arguments=cfg.get("arguments", {}),
+            on_stage_args=cfg.get("on-stage", {"freeze_batchnorm": True}),
+            on_epoch_args=cfg.get("on-epoch", {}),
+        )
+
+    def __init__(self, dropout=0.0, mixed_precision=False, corr_levels=4,
+                 corr_radius=4, corr_channels=256, context_channels=128,
+                 recurrent_channels=128, encoder_norm="instance",
+                 context_norm="batch", encoder_type="raft", context_type="raft",
+                 corr_reg_type="softargmax", corr_reg_args={}, arguments={},
+                 on_epoch_args={}, on_stage_args={"freeze_batchnorm": True}):
+        self.dropout = dropout
+        self.mixed_precision = mixed_precision
+        self.corr_levels = corr_levels
+        self.corr_radius = corr_radius
+        self.corr_channels = corr_channels
+        self.context_channels = context_channels
+        self.recurrent_channels = recurrent_channels
+        self.encoder_norm = encoder_norm
+        self.context_norm = context_norm
+        self.encoder_type = encoder_type
+        self.context_type = context_type
+        self.corr_reg_type = corr_reg_type
+        self.corr_reg_args = corr_reg_args
+
+        super().__init__(
+            RaftModule(
+                dropout=dropout,
+                mixed_precision=mixed_precision,
+                corr_levels=corr_levels,
+                corr_radius=corr_radius,
+                corr_channels=corr_channels,
+                context_channels=context_channels,
+                recurrent_channels=recurrent_channels,
+                encoder_norm=encoder_norm,
+                context_norm=context_norm,
+                encoder_type=encoder_type,
+                context_type=context_type,
+                corr_reg_type=corr_reg_type,
+                corr_reg_args=corr_reg_args,
+            ),
+            arguments=arguments,
+            on_epoch_arguments=on_epoch_args,
+            on_stage_arguments=on_stage_args,
+        )
+
+    def get_config(self):
+        default_args = {
+            "iterations": 12,
+            "upnet": True,
+            "corr_flow": False,
+            "corr_grad_stop": False,
+            "mask_costs": [],
+        }
+
+        return {
+            "type": self.type,
+            "parameters": {
+                "dropout": self.dropout,
+                "mixed-precision": self.mixed_precision,
+                "corr-levels": self.corr_levels,
+                "corr-radius": self.corr_radius,
+                "corr-channels": self.corr_channels,
+                "context-channels": self.context_channels,
+                "recurrent-channels": self.recurrent_channels,
+                "encoder-norm": self.encoder_norm,
+                "context-norm": self.context_norm,
+                "encoder-type": self.encoder_type,
+                "context-type": self.context_type,
+                "corr-reg-type": self.corr_reg_type,
+                "corr-reg-args": self.corr_reg_args,
+            },
+            "arguments": default_args | self.arguments,
+            "on-stage": {"freeze_batchnorm": True} | self.on_stage_arguments,
+            "on-epoch": dict(self.on_epoch_arguments),
+        }
+
+    def get_adapter(self) -> ModelAdapter:
+        return RaftAdapter(self)
+
+
+class RaftAdapter(ModelAdapter):
+    def wrap_result(self, result, original_shape) -> Result:
+        return RaftResult(result)
+
+
+class RaftResult(Result):
+    """Sequence of per-iteration flows; nested per-level lists when the
+    corr-flow readouts are enabled (reference raft.py:570-593)."""
+
+    def __init__(self, output):
+        super().__init__()
+        self.result = output
+        self.has_corr_flow = any(isinstance(x, (list, tuple)) for x in output)
+
+    def output(self, batch_index=None):
+        if batch_index is None:
+            return self.result
+
+        def slice_one(x):
+            return x[batch_index : batch_index + 1]
+
+        if not self.has_corr_flow:
+            return [slice_one(x) for x in self.result]
+        return [[slice_one(x) for x in level] for level in self.result]
+
+    def final(self):
+        if not self.has_corr_flow:
+            return self.result[-1]
+        return self.result[-1][-1]
+
+    def intermediate_flow(self):
+        return self.result
+
+
+@register_loss
+class SequenceLoss(Loss):
+    """γ-weighted distance over the iteration sequence
+    (``raft/sequence``, reference raft.py:596-644)."""
+
+    type = "raft/sequence"
+
+    @classmethod
+    def from_config(cls, cfg):
+        cls._typecheck(cfg)
+        return cls(cfg.get("arguments", {}))
+
+    def __init__(self, arguments={}):
+        super().__init__(arguments)
+
+    def get_config(self):
+        default_args = {"ord": 1, "gamma": 0.8, "include_invalid": False}
+        return {"type": self.type, "arguments": default_args | self.arguments}
+
+    def compute(self, model, result, target, valid, ord=1, gamma=0.8,
+                include_invalid=False):
+        n = len(result)
+        valid_f = valid.astype(jnp.float32)
+
+        loss = 0.0
+        for i, flow in enumerate(result):
+            weight = gamma ** (n - i - 1)
+
+            if ord == "absmean":
+                dist = jnp.abs(flow - target).mean(axis=-1)
+            else:
+                dist = jnp.linalg.norm(flow - target, ord=ord, axis=-1)
+
+            if include_invalid:
+                # invalid pixels enter the mean as zero (original RAFT)
+                loss = loss + weight * (dist * valid_f).mean()
+            else:
+                # mean over valid pixels only
+                loss = loss + weight * (dist * valid_f).sum() / jnp.maximum(
+                    valid_f.sum(), 1.0
+                )
+
+        return loss

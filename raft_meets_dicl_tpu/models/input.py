@@ -1,0 +1,414 @@
+"""Model input pipeline: range scaling, modulo padding, batching, loading.
+
+Reference behavior (src/models/input.py) with a jax-native adapter: batches
+stay NHWC numpy float32 on the host (TPU-native layout — no NCHW transpose
+anywhere), validation marks bad batches via ``meta.valid`` instead of
+raising, and the loader is a thread-pooled iterator (cv2/numpy release the
+GIL) rather than a torch DataLoader with worker processes.
+"""
+
+import concurrent.futures
+from dataclasses import replace
+
+import numpy as np
+
+from .. import utils
+from ..data.collection import Metadata, SampleArgs, SampleId
+
+# Technical flow-magnitude limit (not an optimization knob): non-finite flow
+# values are clamped here so error magnitudes stay computable before masking.
+FLOW_INF = 1e10
+
+
+class Padding:
+    type = None
+
+    @classmethod
+    def _typecheck(cls, cfg):
+        if cfg["type"] != cls.type:
+            raise ValueError(f"invalid padding type '{cfg['type']}', expected '{cls.type}'")
+
+    def get_config(self):
+        raise NotImplementedError
+
+    def apply(self, img1, img2, flow, valid, meta):
+        raise NotImplementedError
+
+    def __call__(self, img1, img2, flow, valid, meta):
+        return self.apply(img1, img2, flow, valid, meta)
+
+
+class ModuloPadding(Padding):
+    """Pad images to a multiple of ``size`` with configurable alignment.
+
+    Flow/valid are always zero-padded (padded pixels are invalid);
+    ``meta.original_extents`` shifts so outputs can be cropped back.
+    ``torch.replicate``/``torch.reflect``/``torch.circular`` mode aliases
+    from reference configs map onto the equivalent numpy modes.
+    """
+
+    type = "modulo"
+
+    _NUMPY_MODES = (
+        "edge", "maximum", "mean", "median", "minimum", "reflect",
+        "symmetric", "wrap",
+    )
+    _ALIASES = {
+        "zeros": ("constant", {"constant_values": 0.0}),
+        "ones": ("constant", {"constant_values": 1.0}),
+        "torch.replicate": ("edge", {}),
+        "torch.reflect": ("reflect", {}),
+        "torch.circular": ("wrap", {}),
+    }
+
+    @classmethod
+    def from_config(cls, cfg):
+        cls._typecheck(cfg)
+
+        size = [int(x) for x in cfg["size"]]
+        if len(size) != 2:
+            raise ValueError("expected list/tuple of 2 integers for attribute 'size'")
+
+        return cls(
+            cfg["mode"],
+            size,
+            align_hz=cfg.get("align-horizontal", "left"),
+            align_vt=cfg.get("align-vertical", "top"),
+        )
+
+    def __init__(self, mode, size, align_hz="left", align_vt="top"):
+        super().__init__()
+
+        if mode not in self._NUMPY_MODES and mode not in self._ALIASES:
+            raise ValueError(f"invalid padding mode: {mode}")
+        if align_hz not in ("left", "center", "right"):
+            raise ValueError(f"invalid horizontal alignment for padding: {align_hz}")
+        if align_vt not in ("bottom", "center", "top"):
+            raise ValueError(f"invalid vertical alignment for padding: {align_vt}")
+
+        self.mode = mode
+        self.size = size
+        self.align_hz = align_hz
+        self.align_vt = align_vt
+
+    def get_config(self):
+        return {
+            "type": self.type,
+            "mode": self.mode,
+            "size": self.size,
+            "align-horizontal": self.align_hz,
+            "align-vertical": self.align_vt,
+        }
+
+    def _split(self, total, align_lo_name, align):
+        if align == align_lo_name:
+            return 0, total
+        if align == "center":
+            return total // 2, total - total // 2
+        return total, 0
+
+    def apply(self, img1, img2, flow, valid, meta):
+        mode, args = self._ALIASES.get(self.mode, (self.mode, {}))
+
+        _, h, w, _ = img1.shape
+        new_h = -(-h // self.size[1]) * self.size[1]
+        new_w = -(-w // self.size[0]) * self.size[0]
+
+        ph1, ph2 = self._split(new_h - h, "top", self.align_vt)
+        pw1, pw2 = self._split(new_w - w, "left", self.align_hz)
+
+        pad4 = ((0, 0), (ph1, ph2), (pw1, pw2), (0, 0))
+        pad3 = ((0, 0), (ph1, ph2), (pw1, pw2))
+
+        img1 = np.pad(img1, pad4, mode=mode, **args)
+        img2 = np.pad(img2, pad4, mode=mode, **args)
+
+        if flow is not None:
+            flow = np.pad(flow, pad4, mode="constant", constant_values=0)
+            valid = np.pad(valid, pad3, mode="constant", constant_values=False)
+
+        # new Metadata objects — sources may hand out the same instances on
+        # every access (e.g. wrap_single), so in-place shifts would accumulate
+        meta = [
+            replace(
+                m,
+                original_extents=(
+                    (m.original_extents[0][0] + ph1, m.original_extents[0][1] + ph1),
+                    (m.original_extents[1][0] + pw1, m.original_extents[1][1] + pw1),
+                ),
+            )
+            for m in meta
+        ]
+
+        return img1, img2, flow, valid, meta
+
+
+_PADDINGS = {ModuloPadding.type: ModuloPadding}
+
+
+def _build_padding(cfg):
+    if cfg is None:
+        return None
+    return _PADDINGS[cfg["type"]].from_config(cfg)
+
+
+class InputSpec:
+    """Model input contract: clip range, value range, optional padding."""
+
+    @classmethod
+    def from_config(cls, cfg):
+        cfg = cfg if cfg is not None else {}
+
+        clip = [float(x) for x in cfg.get("clip", (0, 1))]
+        if len(clip) != 2:
+            raise ValueError("invalid value for 'clip', expected list/tuple of two floats")
+
+        range_ = cfg.get("range", (-1, 1))
+        if len(range_) != 2:
+            raise ValueError("invalid value for 'range', expected list/tuple of two floats")
+
+        return cls(clip, range_, _build_padding(cfg.get("padding")))
+
+    def __init__(self, clip=(0.0, 1.0), range=(-1.0, 1.0), padding=None):
+        self.clip = clip
+        self.range = range
+        self.padding = padding
+
+    def get_config(self):
+        return {
+            "clip": self.clip,
+            "range": self.range,
+            "padding": self.padding.get_config() if self.padding is not None else None,
+        }
+
+    def apply(self, source):
+        return Input(source, self.clip, self.range, self.padding)
+
+    def wrap_single(self, img1, img2, flow=None, valid=None, seq=0, dsid="custom"):
+        """Wrap one unbatched image pair as a one-sample input source."""
+        img1 = img1[None]
+        img2 = img2[None]
+        if flow is not None:
+            flow = flow[None]
+            valid = valid[None]
+
+        meta = [
+            Metadata(
+                valid=True,
+                dataset_id=dsid,
+                sample_id=SampleId(
+                    format="{dsid}/{seq}/{id}",
+                    img1=SampleArgs([], {"dsid": dsid, "seq": seq, "id": 1}),
+                    img2=SampleArgs([], {"dsid": dsid, "seq": seq, "id": 2}),
+                ),
+                original_extents=((0, img1.shape[1]), (0, img1.shape[2])),
+            )
+        ]
+
+        return self.apply([(img1, img2, flow, valid, meta)])
+
+
+class Input:
+    """Applies clip + range scaling + padding over a Collection."""
+
+    def __init__(self, source, clip=(0.0, 1.0), range=(-1.0, 1.0), padding=None):
+        self.source = source
+        self.clip = clip
+        self.range = range
+        self.padding = padding
+
+    def __getitem__(self, index):
+        img1, img2, flow, valid, meta = self.source[index]
+
+        lo, hi = self.clip
+        rmin, rmax = self.range
+
+        img1 = (rmax - rmin) * np.clip(img1, lo, hi) + rmin
+        img2 = (rmax - rmin) * np.clip(img2, lo, hi) + rmin
+
+        if self.padding is not None:
+            img1, img2, flow, valid, meta = self.padding(img1, img2, flow, valid, meta)
+
+        return img1, img2, flow, valid, meta
+
+    def __len__(self):
+        return len(self.source)
+
+    def jax(self, flow=True):
+        return JaxAdapter(self, flow)
+
+    # alias so call sites written against the reference's `.torch()` read
+    # naturally during porting
+    def adapter(self, flow=True):
+        return JaxAdapter(self, flow)
+
+
+class JaxAdapter:
+    """Validates batches and normalizes them to NHWC float32 numpy.
+
+    Device placement happens later (in the train/eval step or loader
+    prefetch), so this stays a pure host-side transform. Non-finite images
+    or flow, or empty valid masks, mark the whole sample batch invalid via
+    ``meta.valid`` — the trainer skips those batches with a warning, exactly
+    like the reference (src/models/input.py:252-299).
+    """
+
+    def __init__(self, source, flow=True, validate=True):
+        self.source = source
+        self.flow = flow
+        self.validate = validate
+        self.log = utils.logging.Logger("data:jax-adapter")
+
+    def __getitem__(self, index):
+        img1, img2, flow, valid, meta = self.source[index]
+
+        if self.validate:
+            self._validate_images(img1, img2, meta)
+
+        img1 = np.ascontiguousarray(img1, dtype=np.float32)
+        img2 = np.ascontiguousarray(img2, dtype=np.float32)
+
+        if not self.flow:
+            return img1, img2, None, None, meta
+
+        assert flow is not None and valid is not None
+
+        if self.validate:
+            self._validate_flow(flow, valid, meta)
+
+        flow = np.nan_to_num(flow, nan=0.0, posinf=FLOW_INF, neginf=-FLOW_INF)
+        flow = np.clip(flow, -FLOW_INF, FLOW_INF)
+
+        flow = np.ascontiguousarray(flow, dtype=np.float32)
+        valid = np.ascontiguousarray(valid, dtype=bool)
+
+        return img1, img2, flow, valid, meta
+
+    def _mark_invalid(self, meta, which, bad_mask):
+        for i, bad in enumerate(bad_mask):
+            if bad:
+                self.log.warn(f"{which}: {meta[i].sample_id}")
+        for m in meta:
+            m.valid = False
+
+    def _validate_images(self, img1, img2, meta):
+        bad1 = ~np.all(np.isfinite(img1), axis=(1, 2, 3))
+        if bad1.any():
+            self._mark_invalid(meta, "non-finite values in img1 detected", bad1)
+
+        bad2 = ~np.all(np.isfinite(img2), axis=(1, 2, 3))
+        if bad2.any():
+            self._mark_invalid(meta, "non-finite values in img2 detected", bad2)
+
+    def _validate_flow(self, flow, valid, meta):
+        no_valid = ~np.any(valid, axis=(1, 2))
+        if no_valid.any():
+            self._mark_invalid(meta, "sample contains no valid flow pixels", no_valid)
+
+        nonfinite = np.array(
+            [not np.all(np.isfinite(flow[b][valid[b]])) for b in range(flow.shape[0])]
+        )
+        if nonfinite.any():
+            self._mark_invalid(meta, "non-finite values in flow detected", nonfinite)
+
+    def __len__(self):
+        return len(self.source)
+
+    def loader(self, batch_size=1, shuffle=False, num_workers=4, drop_last=False,
+               seed=None, **loader_args):
+        return Loader(self, batch_size, shuffle, num_workers, drop_last, seed)
+
+
+def collate(samples, shuffle=False, rng=None):
+    """Concatenate pre-batched samples into one global batch.
+
+    Sources may return more than one sample each (fw/bw pairing); the global
+    batch is the concatenation, optionally shuffled within the batch so
+    paired samples don't always sit next to each other.
+    """
+    img1 = np.concatenate([s[0] for s in samples], axis=0)
+    img2 = np.concatenate([s[1] for s in samples], axis=0)
+
+    if samples[0][2] is not None:
+        flow = np.concatenate([s[2] for s in samples], axis=0)
+        valid = np.concatenate([s[3] for s in samples], axis=0)
+    else:
+        flow, valid = None, None
+
+    meta = [m for s in samples for m in s[4]]
+
+    if shuffle and img1.shape[0] > 1:
+        rng = rng if rng is not None else np.random
+        perm = rng.permutation(img1.shape[0])
+        img1, img2 = img1[perm], img2[perm]
+        if flow is not None:
+            flow, valid = flow[perm], valid[perm]
+        meta = [meta[i] for i in perm]
+
+    return img1, img2, flow, valid, meta
+
+
+class Loader:
+    """Thread-pooled batching iterator over an adapter.
+
+    Epoch order reshuffles on every ``__iter__`` when ``shuffle`` is set;
+    within-batch shuffle mixes samples from pre-batched sources. Threads
+    (not processes) are enough here because cv2/numpy release the GIL for
+    the heavy work.
+
+    Shuffling uses an own Generator. Without an explicit ``seed`` it is
+    derived from the global numpy RNG so run-level seeding
+    (utils.seeds) still makes data order reproducible.
+    """
+
+    def __init__(self, source, batch_size=1, shuffle=False, num_workers=4,
+                 drop_last=False, seed=None):
+        self.source = source
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.num_workers = num_workers
+        self.drop_last = drop_last
+        if seed is None:
+            seed = int(np.random.randint(0, 2**31 - 1))
+        self.rng = np.random.default_rng(seed)
+
+    def __len__(self):
+        n = len(self.source)
+        if self.drop_last:
+            return n // self.batch_size
+        return -(-n // self.batch_size)
+
+    def _batches(self):
+        order = self.rng.permutation(len(self.source)) if self.shuffle \
+            else np.arange(len(self.source))
+
+        for start in range(0, len(order), self.batch_size):
+            chunk = order[start : start + self.batch_size]
+            if self.drop_last and len(chunk) < self.batch_size:
+                return
+            yield chunk
+
+    def __iter__(self):
+        if self.num_workers <= 0:
+            for chunk in self._batches():
+                samples = [self.source[i] for i in chunk]
+                yield collate(samples, self.shuffle, self.rng)
+            return
+
+        with concurrent.futures.ThreadPoolExecutor(self.num_workers) as pool:
+            # pipeline: submit the next batch while the consumer works
+            pending = []
+            batches = self._batches()
+
+            def submit_next():
+                chunk = next(batches, None)
+                if chunk is not None:
+                    pending.append([pool.submit(self.source.__getitem__, i) for i in chunk])
+
+            submit_next()
+            submit_next()
+            while pending:
+                futures = pending.pop(0)
+                samples = [f.result() for f in futures]
+                submit_next()
+                yield collate(samples, self.shuffle, self.rng)

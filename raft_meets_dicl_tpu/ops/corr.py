@@ -55,15 +55,20 @@ def correlation_pyramid(corr, num_levels=4):
     return pyramid
 
 
-def _window_delta(radius, dtype=jnp.float32):
+def window_delta(radius, dtype=jnp.float32):
     """(K, K, 2) window offsets; axis 0 varies x, axis 1 varies y.
 
     Matches the reference's ``meshgrid(dx, dy, indexing='ij')`` layout
-    (raft.py:57-59): delta[a, b] = (dx_a, dy_b).
+    (raft.py:57-59): delta[a, b] = (dx_a, dy_b). This ordering defines the
+    channel layout of every windowed lookup/readout in the framework —
+    import it rather than re-deriving it.
     """
     d = jnp.linspace(-radius, radius, 2 * radius + 1, dtype=dtype)
     dx, dy = jnp.meshgrid(d, d, indexing="ij")
     return jnp.stack((dx, dy), axis=-1)
+
+
+_window_delta = window_delta
 
 
 def _lookup_level(corr, x, y):

@@ -1,0 +1,130 @@
+"""Input pipeline tests: padding, range scaling, validation, loader."""
+
+import numpy as np
+
+from raft_meets_dicl_tpu.data.collection import Metadata, SampleArgs, SampleId
+from raft_meets_dicl_tpu.models import input as minput
+
+
+def _meta(h, w, b=1):
+    return [
+        Metadata(True, "t", SampleId("s", SampleArgs(), SampleArgs()), ((0, h), (0, w)))
+        for _ in range(b)
+    ]
+
+
+def _sample(h=30, w=40, b=1):
+    img1 = np.random.rand(b, h, w, 3).astype(np.float32)
+    img2 = np.random.rand(b, h, w, 3).astype(np.float32)
+    flow = np.random.randn(b, h, w, 2).astype(np.float32)
+    valid = np.ones((b, h, w), bool)
+    return img1, img2, flow, valid, _meta(h, w, b)
+
+
+def test_modulo_padding_shapes_and_extents():
+    pad = minput.ModuloPadding("zeros", [16, 8])  # (w multiple, h multiple)
+    img1, img2, flow, valid, meta = pad(*_sample(30, 40))
+
+    assert img1.shape == (1, 32, 48, 3)
+    assert flow.shape == (1, 32, 48, 2)
+    assert valid.shape == (1, 32, 48)
+    assert not valid[0, 31, 0]  # padded rows invalid
+    assert meta[0].original_extents == ((0, 30), (0, 40))
+
+
+def test_modulo_padding_center_alignment():
+    pad = minput.ModuloPadding("zeros", [16, 8], align_hz="center", align_vt="center")
+    img1, _, _, _, meta = pad(*_sample(30, 40))
+    (y0, y1), (x0, x1) = meta[0].original_extents
+    assert (y0, y1) == (1, 31)
+    assert (x0, x1) == (4, 44)
+    assert img1[0, 0].sum() == 0  # padded border
+
+
+def test_modulo_padding_torch_mode_aliases():
+    pad = minput.ModuloPadding("torch.replicate", [16, 8])
+    img1, *_ = pad(*_sample(30, 40))
+    # replicated edge rows equal the last content row
+    np.testing.assert_array_equal(img1[0, 30], img1[0, 29])
+
+
+def test_input_range_scaling():
+    spec = minput.InputSpec(clip=(0, 1), range=(-1, 1))
+    src = [_sample()]
+    inp = spec.apply(src)
+    img1, *_ = inp[0]
+    assert img1.min() >= -1.0 and img1.max() <= 1.0
+
+
+def test_input_spec_roundtrip():
+    cfg = {
+        "clip": [0, 1],
+        "range": [-1, 1],
+        "padding": {"type": "modulo", "mode": "zeros", "size": [8, 8]},
+    }
+    spec = minput.InputSpec.from_config(cfg)
+    cfg2 = spec.get_config()
+    assert cfg2["padding"]["size"] == [8, 8]
+    spec2 = minput.InputSpec.from_config(cfg2)
+    assert spec2.padding.mode == "zeros"
+
+
+def test_adapter_marks_nonfinite_invalid():
+    img1, img2, flow, valid, meta = _sample()
+    img1[0, 0, 0, 0] = np.nan
+
+    adapter = minput.JaxAdapter([(img1, img2, flow, valid, meta)])
+    *_, meta_out = adapter[0]
+    assert not meta_out[0].valid
+
+
+def test_adapter_scrubs_nonfinite_flow():
+    img1, img2, flow, valid, meta = _sample()
+    flow[0, 1, 1, 0] = np.inf
+
+    adapter = minput.JaxAdapter([(img1, img2, flow, valid, meta)])
+    _, _, flow_out, _, meta_out = adapter[0]
+    assert not meta_out[0].valid
+    assert np.isfinite(flow_out).all()
+    assert flow_out.max() <= minput.FLOW_INF
+
+
+def test_adapter_empty_valid_mask():
+    img1, img2, flow, valid, meta = _sample()
+    valid[:] = False
+
+    adapter = minput.JaxAdapter([(img1, img2, flow, valid, meta)])
+    *_, meta_out = adapter[0]
+    assert not meta_out[0].valid
+
+
+def test_loader_batches_and_drop_last():
+    source = [_sample() for _ in range(5)]
+    adapter = minput.JaxAdapter(source)
+
+    loader = adapter.loader(batch_size=2, shuffle=False, num_workers=0, drop_last=True)
+    batches = list(loader)
+    assert len(batches) == 2
+    assert all(b[0].shape[0] == 2 for b in batches)
+
+    loader = adapter.loader(batch_size=2, shuffle=False, num_workers=2, drop_last=False)
+    batches = list(loader)
+    assert len(batches) == 3
+    assert batches[-1][0].shape[0] == 1
+
+
+def test_collate_concatenates_prebatched():
+    s1 = _sample(b=2)
+    s2 = _sample(b=1)
+    img1, img2, flow, valid, meta = minput.collate([s1, s2])
+    assert img1.shape[0] == 3
+    assert len(meta) == 3
+
+
+def test_wrap_single():
+    spec = minput.InputSpec()
+    img = np.random.rand(30, 40, 3).astype(np.float32)
+    inp = spec.wrap_single(img, img)
+    img1, img2, flow, valid, meta = inp[0]
+    assert img1.shape == (1, 30, 40, 3)
+    assert flow is None

@@ -1,0 +1,169 @@
+"""RAFT model tests: components, full model, loss, registry."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import raft_meets_dicl_tpu.models as models
+from raft_meets_dicl_tpu.models.impls import raft as raft_impl
+
+TINY = {
+    "name": "tiny", "id": "tiny",
+    "model": {
+        "type": "raft/baseline",
+        "parameters": {
+            "corr-levels": 3, "corr-radius": 2, "corr-channels": 32,
+            "context-channels": 16, "recurrent-channels": 16,
+        },
+        "arguments": {"iterations": 2},
+    },
+    "loss": {"type": "raft/sequence"},
+    "input": None,
+}
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    spec = models.load(TINY)
+    rng = jax.random.PRNGKey(0)
+    img = jnp.asarray(np.random.RandomState(0).randn(1, 32, 48, 3), jnp.float32)
+    variables = spec.model.init(rng, img, img)
+    return spec, variables, img
+
+
+def test_registry_unknown_type():
+    with pytest.raises(ValueError, match="unknown model type"):
+        models.load_model({"type": "nope"})
+    with pytest.raises(ValueError, match="unknown loss type"):
+        models.load_loss({"type": "nope"})
+
+
+def test_raft_forward_shapes(tiny_model):
+    spec, variables, img = tiny_model
+    out = spec.model.apply(variables, img, img)
+    assert len(out) == 2
+    assert out[0].shape == (1, 32, 48, 2)
+
+
+def test_raft_zero_motion_small_flow(tiny_model):
+    # identical frames: flow output must be small even untrained? Not
+    # guaranteed — but must be finite and well-formed.
+    spec, variables, img = tiny_model
+    out = spec.model.apply(variables, img, img)
+    assert np.isfinite(np.asarray(out[-1])).all()
+
+
+def test_raft_corr_flow_structure(tiny_model):
+    spec, variables, img = tiny_model
+    out = spec.model.apply(variables, img, img, corr_flow=True)
+    # 3 corr levels (coarse→fine) + final sequence
+    assert len(out) == 4
+    assert len(out[-1]) == 2
+    assert out[0][0].shape == (1, 4, 6, 2)  # 1/8-scale corr-flow readout
+
+
+def test_raft_flow_init(tiny_model):
+    spec, variables, img = tiny_model
+    finit = jnp.ones((1, 4, 6, 2))
+    out = spec.model.apply(variables, img, img, flow_init=finit)
+    assert out[0].shape == (1, 32, 48, 2)
+
+
+def test_raft_adapter_result(tiny_model):
+    spec, variables, img = tiny_model
+    out = spec.model.apply(variables, img, img)
+    result = spec.model.get_adapter().wrap_result(out, (32, 48))
+    assert result.final().shape == (1, 32, 48, 2)
+    sliced = result.output(0)
+    assert sliced[0].shape == (1, 32, 48, 2)
+
+
+def test_raft_train_mode_returns_batch_stats(tiny_model):
+    spec, variables, img = tiny_model
+    out, bs = spec.model.apply(variables, img, img, train=True)
+    assert len(out) == 2
+    assert bs  # context encoder uses batch norm
+
+
+def test_raft_freeze_batchnorm(tiny_model):
+    spec, variables, img = tiny_model
+    spec.model.on_stage(None, freeze_batchnorm=True)
+    try:
+        out, bs = spec.model.apply(variables, img, img, train=True)
+        # frozen: returned stats are the originals (no update)
+        orig = variables["batch_stats"]
+        same = jax.tree.all(
+            jax.tree.map(lambda a, b: bool(jnp.all(a == b)), bs, orig)
+        )
+        assert same
+    finally:
+        spec.model.on_stage(None, freeze_batchnorm=False)
+
+
+def test_sequence_loss_golden():
+    loss = models.load_loss({"type": "raft/sequence"})
+
+    flow1 = jnp.ones((1, 4, 4, 2))
+    flow2 = jnp.full((1, 4, 4, 2), 2.0)
+    target = jnp.zeros((1, 4, 4, 2))
+    valid = jnp.ones((1, 4, 4), bool)
+
+    # dist(L1 over channels): flow1 → 2, flow2 → 4; gamma 0.8
+    val = float(loss(None, [flow1, flow2], target, valid))
+    assert np.isclose(val, 0.8 * 2.0 + 1.0 * 4.0, atol=1e-5)
+
+
+def test_sequence_loss_valid_masking():
+    loss = models.load_loss({"type": "raft/sequence"})
+
+    flow = jnp.ones((1, 2, 2, 2))
+    target = jnp.zeros((1, 2, 2, 2))
+    valid = jnp.array([[[True, False], [False, False]]])
+
+    val = float(loss(None, [flow], target, valid))
+    assert np.isclose(val, 2.0, atol=1e-5)  # only the valid pixel counts
+
+
+def test_up8_constant_flow():
+    # convex combination of a constant flow is the same constant (×8)
+    up = raft_impl.Up8Network()
+    rng = jax.random.PRNGKey(0)
+    hidden = jax.random.normal(rng, (1, 4, 4, 16))
+    flow = jnp.full((1, 4, 4, 2), 1.5)
+    variables = up.init(rng, hidden, flow)
+    out = up.apply(variables, hidden, flow)
+    assert out.shape == (1, 32, 32, 2)
+    # interior pixels only: border windows include zero padding
+    np.testing.assert_allclose(np.asarray(out[:, 8:24, 8:24]), 12.0, atol=1e-5)
+
+
+def test_softargmax_regression_peak():
+    # a cost volume sharply peaked at displacement (dx=2, dy=-1) reads out
+    # approximately that displacement
+    radius = 3
+    k = 2 * radius + 1
+    corr = np.zeros((1, 4, 4, k * k), np.float32)
+    dx_idx, dy_idx = 2 + radius, -1 + radius
+    corr[..., dx_idx * k + dy_idx] = 50.0
+
+    reg = raft_impl.SoftArgMaxFlowRegression(num_levels=1, radius=radius)
+    variables = reg.init(jax.random.PRNGKey(0), jnp.asarray(corr))
+    (flow,) = reg.apply(variables, jnp.asarray(corr))
+    np.testing.assert_allclose(np.asarray(flow[0, 0, 0]), [2.0, -1.0], atol=1e-4)
+
+
+def test_unfold3x3_center():
+    x = jnp.arange(16, dtype=jnp.float32).reshape(1, 4, 4, 1)
+    w = raft_impl.unfold3x3(x)
+    assert w.shape == (1, 4, 4, 9, 1)
+    # center of each window is the pixel itself
+    np.testing.assert_array_equal(np.asarray(w[..., 4, :]), np.asarray(x))
+
+
+def test_model_config_roundtrip():
+    spec = models.load(TINY)
+    cfg = spec.get_config()
+    spec2 = models.load(cfg)
+    assert spec2.model.corr_levels == 3
+    assert cfg["model"]["arguments"]["iterations"] == 2
