@@ -1,0 +1,85 @@
+"""Benchmark: RAFT training throughput in image-pairs/sec/chip.
+
+Mirrors the reference's FlyingThings3D training configuration (batch 6,
+720x400 crops, 12 GRU iterations, AdamW + grad clip —
+cfg/strategy/baseline/raft/s1-things.yaml) as a synthetic-data training-step
+benchmark on one chip. Prints ONE JSON line.
+
+``vs_baseline`` compares against the north-star target of 400 image-pairs/s
+on a v4-32 (32 chips) => 12.5 pairs/s/chip (BASELINE.json; the reference
+repo publishes no throughput numbers of its own).
+"""
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BASELINE_PAIRS_PER_SEC_PER_CHIP = 400.0 / 32.0
+
+
+def main():
+    import optax
+
+    import raft_meets_dicl_tpu.models as models
+    from raft_meets_dicl_tpu import parallel
+
+    batch = int(os.environ.get("BENCH_BATCH", "6"))
+    height = int(os.environ.get("BENCH_HEIGHT", "400"))
+    width = int(os.environ.get("BENCH_WIDTH", "720"))
+    iters = int(os.environ.get("BENCH_ITERS", "12"))
+    steps = int(os.environ.get("BENCH_STEPS", "10"))
+
+    if jax.default_backend() == "cpu":
+        # CPU fallback (no TPU attached): tiny shapes, still one JSON line
+        batch, height, width, iters, steps = 2, 64, 96, 4, 3
+
+    spec = models.load({
+        "name": "bench", "id": "bench",
+        "model": {"type": "raft/baseline", "parameters": {}},
+        "loss": {"type": "raft/sequence"},
+        "input": None,
+    })
+    model, loss = spec.model, spec.loss
+
+    rng = np.random.RandomState(0)
+    img1 = jnp.asarray(rng.rand(batch, height, width, 3), jnp.float32)
+    img2 = jnp.asarray(rng.rand(batch, height, width, 3), jnp.float32)
+    flow = jnp.asarray(rng.randn(batch, height, width, 2), jnp.float32)
+    valid = jnp.ones((batch, height, width), bool)
+
+    variables = model.init(jax.random.PRNGKey(0), img1[:1], img2[:1], iterations=2)
+
+    tx = optax.chain(optax.clip_by_global_norm(1.0), optax.adamw(4e-4))
+    state = parallel.TrainState.create(variables, tx)
+
+    step = parallel.make_train_step(
+        model, loss, tx, model_args={"iterations": iters}
+    )
+
+    # warmup / compile; sync by fetching the scalar — on the tunneled axon
+    # backend block_until_ready does not reliably wait, value transfer does
+    state, aux = step(state, img1, img2, flow, valid)
+    float(aux["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, aux = step(state, img1, img2, flow, valid)
+    float(aux["loss"])
+    dt = time.perf_counter() - t0
+
+    pairs_per_sec = batch * steps / dt
+
+    print(json.dumps({
+        "metric": "train-throughput-raft-things",
+        "value": round(pairs_per_sec, 3),
+        "unit": "image-pairs/sec/chip",
+        "vs_baseline": round(pairs_per_sec / BASELINE_PAIRS_PER_SEC_PER_CHIP, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -34,3 +34,7 @@ Layout (mirrors the reference's layer map, SURVEY.md §1, redesigned TPU-first):
 """
 
 __version__ = "0.1.0"
+
+from . import data, models, ops, parallel, strategy, utils  # noqa: E402
+
+__all__ = ["data", "models", "ops", "parallel", "strategy", "utils"]

@@ -239,6 +239,7 @@ class RaftModule(nn.Module):
     context_type: str = "raft"
     corr_reg_type: str = "softargmax"
     corr_reg_args: dict = None
+    remat: bool = True
 
     @nn.compact
     def __call__(self, img1, img2, train=False, frozen_bn=False, iterations=12,
@@ -273,8 +274,12 @@ class RaftModule(nn.Module):
         coords0 = coordinate_grid(b, hc, wc)
         coords1 = coords0 + flow_init if flow_init is not None else coords0
 
+        # remat the scan body: recompute iteration activations in the
+        # backward pass instead of storing 12 iterations' worth in HBM —
+        # this is what makes full-resolution training fit on one chip
+        body = nn.remat(_RaftStep, prevent_cse=False) if self.remat else _RaftStep
         step = nn.scan(
-            _RaftStep,
+            body,
             variable_broadcast="params",
             split_rngs={"params": False, "dropout": True},
             in_axes=nn.broadcast,

@@ -1,0 +1,24 @@
+"""Distributed execution layer: device meshes, sharded train steps.
+
+The reference's entire parallelism story is single-process
+``nn.DataParallel`` (src/cmd/train.py:183-184 — scatter the batch over
+GPUs, implicit NCCL). The TPU-native equivalent is SPMD over a
+``jax.sharding.Mesh``: annotate the batch with a ``data`` axis sharding,
+keep parameters replicated, and let XLA insert the gradient all-reduces
+over ICI. The same compiled program runs single-chip, one pod slice, or
+multi-host over DCN (with ``jax.distributed.initialize``) — there is no
+separate code path.
+
+Axes:
+- ``data``  — batch parallelism (the reference's DataParallel equivalent)
+- ``space`` — optional spatial sharding for the O(H²W²) correlation volume
+  at high resolution (the framework's long-context axis)
+"""
+
+from .mesh import data_mesh, replicate, shard_batch
+from .train import TrainState, make_eval_step, make_train_step
+
+__all__ = [
+    "data_mesh", "replicate", "shard_batch",
+    "TrainState", "make_eval_step", "make_train_step",
+]

@@ -1,0 +1,33 @@
+"""Mesh construction and pytree sharding helpers."""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def data_mesh(n_devices=None, axis_name="data", devices=None):
+    """1-D mesh over ``n_devices`` (default: all) for data parallelism."""
+    devs = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(
+                f"requested {n_devices} devices, only {len(devs)} available"
+            )
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis_name,))
+
+
+def shard_batch(batch, mesh, axis_name="data"):
+    """Place a host batch on the mesh, sharded along the leading axis.
+
+    The global batch size must divide the mesh axis size. Works on any
+    pytree of arrays with a common leading batch dimension.
+    """
+    spec = NamedSharding(mesh, P(axis_name))
+    return jax.tree.map(lambda x: jax.device_put(x, spec), batch)
+
+
+def replicate(tree, mesh):
+    """Replicate a pytree (params, optimizer state) across the mesh."""
+    spec = NamedSharding(mesh, P())
+    return jax.tree.map(lambda x: jax.device_put(x, spec), tree)

@@ -1,0 +1,147 @@
+"""Sharded train/eval step builders.
+
+One jitted SPMD program: parameters replicated, batch sharded over the
+``data`` mesh axis. The loss is a global mean, so XLA's partitioner emits
+the psum/all-reduce over ICI by itself — the explicit NCCL choreography the
+reference delegates to ``nn.DataParallel`` doesn't exist here.
+
+Gradient clipping and accumulation are optax transforms configured by the
+strategy layer; this module only owns the step function shape.
+"""
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+class TrainState(struct.PyTreeNode):
+    """Everything the train step carries: params, BN stats, optimizer."""
+
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+    step: jax.Array
+
+    @classmethod
+    def create(cls, variables, tx):
+        params = variables["params"]
+        return cls(
+            params=params,
+            batch_stats=variables.get("batch_stats", {}),
+            opt_state=tx.init(params),
+            step=jnp.zeros((), jnp.int32),
+        )
+
+    def variables(self):
+        return {"params": self.params, "batch_stats": self.batch_stats}
+
+
+def make_train_step(model, loss_fn, tx, mesh=None, loss_args=None,
+                    model_args=None, donate=True, external_lr=False):
+    """Build the jitted training step.
+
+    Static per-stage configuration (``model_args``, ``loss_args``) is baked
+    in — a new stage builds a new step function, recompiling as the
+    reference re-builds its optimizer per stage.
+
+    With ``external_lr`` the step takes the learning rate as its second
+    argument and scales the optimizer's (lr-less) updates by ``-lr`` — the
+    strategy layer's host-side schedulers drive it. Without it, ``tx`` must
+    contain its own lr scaling.
+
+    With ``mesh``, input/output shardings are annotated: state replicated,
+    batch split on the leading axis over ``data``.
+    """
+    loss_args = dict(loss_args or {})
+    model_args = dict(model_args or {})
+
+    def step(state, lr, img1, img2, flow, valid):
+        def compute_loss(params):
+            out, new_bs = model.apply(
+                {"params": params, "batch_stats": state.batch_stats},
+                img1, img2, train=True, **model_args,
+            )
+            result = model.get_adapter().wrap_result(out, img1.shape[1:3])
+            l = loss_fn(model, result.output(), flow, valid, **loss_args)
+            return l, (new_bs, result.final())
+
+        (loss, (new_bs, final)), grads = jax.value_and_grad(
+            compute_loss, has_aux=True
+        )(state.params)
+
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        if external_lr:
+            updates = jax.tree.map(lambda u: -lr * u, updates)
+        new_params = optax.apply_updates(state.params, updates)
+
+        new_state = state.replace(
+            params=new_params,
+            batch_stats=new_bs,
+            opt_state=new_opt,
+            step=state.step + 1,
+        )
+        aux = {
+            "loss": loss,
+            "final": final,
+            "grads": grads,
+            "finite": jnp.all(jnp.isfinite(final)),
+        }
+        return new_state, aux
+
+    if not external_lr:
+        # bind a dummy lr so the public signature stays (state, batch...)
+        inner = step
+
+        def step_no_lr(state, img1, img2, flow, valid):
+            return inner(state, 0.0, img1, img2, flow, valid)
+
+        if mesh is None:
+            return jax.jit(step_no_lr, donate_argnums=(0,) if donate else ())
+
+        repl = NamedSharding(mesh, P())
+        data = NamedSharding(mesh, P("data"))
+        return jax.jit(
+            step_no_lr,
+            in_shardings=(repl, data, data, data, data),
+            out_shardings=(
+                repl,
+                {"loss": repl, "final": data, "grads": repl, "finite": repl},
+            ),
+            donate_argnums=(0,) if donate else (),
+        )
+
+    if mesh is None:
+        return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+    repl = NamedSharding(mesh, P())
+    data = NamedSharding(mesh, P("data"))
+    return jax.jit(
+        step,
+        in_shardings=(repl, None, data, data, data, data),
+        out_shardings=(
+            repl,
+            {"loss": repl, "final": data, "grads": repl, "finite": repl},
+        ),
+        donate_argnums=(0,) if donate else (),
+    )
+
+
+def make_eval_step(model, mesh=None, model_args=None):
+    """Build the jitted inference step returning the final flow."""
+    model_args = dict(model_args or {})
+
+    def step(variables, img1, img2):
+        out = model.apply(variables, img1, img2, train=False, **model_args)
+        result = model.get_adapter().wrap_result(out, img1.shape[1:3])
+        return result.final()
+
+    if mesh is None:
+        return jax.jit(step)
+
+    repl = NamedSharding(mesh, P())
+    data = NamedSharding(mesh, P("data"))
+    return jax.jit(step, in_shardings=(repl, data, data), out_shardings=data)

@@ -1,0 +1,14 @@
+"""Training strategy layer: specs, runtime, checkpoints, inspector."""
+
+from . import checkpoint, config, inspector, spec, training
+from .checkpoint import Checkpoint, CheckpointManager
+from .config import load, load_stage
+from .inspector import Inspector
+from .spec import Stage, Strategy
+from .training import TrainingContext
+
+__all__ = [
+    "checkpoint", "config", "inspector", "spec", "training",
+    "Checkpoint", "CheckpointManager", "Inspector", "Stage", "Strategy",
+    "TrainingContext", "load", "load_stage",
+]

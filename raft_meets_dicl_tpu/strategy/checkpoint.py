@@ -1,0 +1,332 @@
+"""Checkpointing: single-file msgpack checkpoints + retention manager.
+
+Same logical schema as the reference (src/strategy/checkpoint.py:38-121):
+``{model, iteration{stage,epoch,step}, metrics, state{model, optimizer,
+scaler, lr-scheduler{instance,epoch}}, metadata}`` — serialized with flax
+msgpack instead of torch.save. ``state.model`` holds the flax variables
+``{params, batch_stats}``; ``state.optimizer`` holds the optax state as a
+flax state-dict (restored against a freshly built optimizer's structure).
+
+Retention (name-templated paths with metric values, best-by-expression and
+keep-latest trimming) matches the reference manager exactly.
+"""
+
+import re
+from collections import defaultdict
+from dataclasses import dataclass
+from datetime import datetime
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+from flax import serialization
+
+from .. import utils
+
+_MAGIC = b"RMDT1\n"
+
+
+@dataclass
+class Iteration:
+    stage: int
+    epoch: Optional[int]
+    step: int
+
+    @classmethod
+    def from_dict(cls, cfg):
+        return cls(stage=cfg["stage"], epoch=cfg.get("epoch"), step=cfg["step"])
+
+    def to_dict(self):
+        return {"stage": self.stage, "epoch": self.epoch, "step": self.step}
+
+
+@dataclass
+class State:
+    model: Any          # {'params': ..., 'batch_stats': ...}
+    optimizer: Any      # optax state as flax state-dict
+    scaler: Any
+    lr_sched_inst: List[Any]
+    lr_sched_epoch: List[Any]
+
+    @classmethod
+    def from_dict(cls, cfg):
+        return cls(
+            model=cfg["model"],
+            optimizer=cfg["optimizer"],
+            scaler=cfg["scaler"],
+            lr_sched_inst=cfg["lr-scheduler"]["instance"],
+            lr_sched_epoch=cfg["lr-scheduler"]["epoch"],
+        )
+
+    def to_dict(self):
+        return {
+            "model": self.model,
+            "optimizer": self.optimizer,
+            "scaler": self.scaler,
+            "lr-scheduler": {
+                "instance": self.lr_sched_inst,
+                "epoch": self.lr_sched_epoch,
+            },
+        }
+
+
+def _to_host(tree):
+    """Device arrays → numpy for serialization."""
+    import jax
+
+    return jax.tree.map(np.asarray, tree)
+
+
+@dataclass
+class Checkpoint:
+    model: str
+    iteration: Iteration
+    metrics: Optional[Dict[str, float]]
+    state: State
+    metadata: Dict[str, Any]
+
+    @classmethod
+    def from_dict(cls, cfg):
+        return cls(
+            model=cfg["model"],
+            iteration=Iteration.from_dict(cfg["iteration"]),
+            metrics=cfg["metrics"],
+            state=State.from_dict(cfg["state"]),
+            metadata=cfg.get("metadata", {}),
+        )
+
+    @classmethod
+    def load(cls, path, strip_prefix=None):
+        raw = Path(path).read_bytes()
+        if not raw.startswith(_MAGIC):
+            raise ValueError(f"not a checkpoint file: {path}")
+
+        cfg = serialization.msgpack_restore(raw[len(_MAGIC):])
+
+        if strip_prefix:
+            # pytree-key analog of the reference's module.-prefix stripping
+            cfg["state"]["model"] = {
+                k.removeprefix(strip_prefix): v
+                for k, v in cfg["state"]["model"].items()
+            }
+
+        return cls.from_dict(cfg)
+
+    def to_dict(self):
+        return {
+            "model": self.model,
+            "iteration": self.iteration.to_dict(),
+            "metrics": self.metrics,
+            "state": self.state.to_dict(),
+            "metadata": self.metadata,
+        }
+
+    def to_entry(self, path):
+        return CheckpointEntry(
+            self.model,
+            self.iteration.stage,
+            self.iteration.epoch,
+            self.iteration.step,
+            self.metrics,
+            path,
+        )
+
+    def save(self, path):
+        payload = serialization.msgpack_serialize(_to_host(self.to_dict()))
+        Path(path).write_bytes(_MAGIC + payload)
+
+    def apply(self, variables=None, opt_state=None, scaler=None,
+              lr_sched_inst=(), lr_sched_epoch=()):
+        """Restore state in place-of: returns (variables, opt_state, scaler).
+
+        ``variables``/``opt_state`` act as structure targets (flax
+        ``from_state_dict``); schedulers are restored in place. Pass None to
+        skip a slot.
+        """
+        out_vars, out_opt, out_scaler = variables, opt_state, scaler
+
+        if variables is not None:
+            out_vars = serialization.from_state_dict(variables, self.state.model)
+        if opt_state is not None:
+            out_opt = serialization.from_state_dict(opt_state, self.state.optimizer)
+        if scaler is not None:
+            out_scaler = dict(self.state.scaler)
+
+        for sched, state in zip(lr_sched_inst, self.state.lr_sched_inst):
+            sched.load_state_dict(state)
+        for sched, state in zip(lr_sched_epoch, self.state.lr_sched_epoch):
+            sched.load_state_dict(state)
+
+        return out_vars, out_opt, out_scaler
+
+
+@dataclass
+class CheckpointEntry:
+    model: str
+    idx_stage: int
+    idx_epoch: Optional[int]
+    idx_step: int
+    metrics: Optional[Dict[str, float]]
+    path: Optional[Path]
+
+    def load(self, **kwargs) -> Checkpoint:
+        return Checkpoint.load(self.path, **kwargs)
+
+    def __hash__(self):
+        return hash((self.model, self.idx_stage, self.idx_epoch, self.idx_step,
+                     self.path))
+
+    def __eq__(self, o):
+        if not isinstance(o, CheckpointEntry):
+            return NotImplemented
+        return (
+            self.model == o.model
+            and self.idx_stage == o.idx_stage
+            and self.idx_epoch == o.idx_epoch
+            and self.idx_step == o.idx_step
+            and self.path == o.path
+        )
+
+
+class CheckpointManager:
+    """Name-templated checkpoint store with best/latest retention.
+
+    ``compare`` is a list of metric expressions (e.g.
+    ``'{m_EndPointError_mean}'``) evaluated over a checkpoint's metrics;
+    lexicographically smallest wins.
+    """
+
+    def __init__(self, model_id, path, name, compare, keep_latest=None,
+                 keep_best=None):
+        self.model_id = model_id
+        self.path = Path(path)
+        self.name = name
+        self.compare = list(compare)
+        self.checkpoints: List[CheckpointEntry] = []
+        self.keep_latest = keep_latest
+        self.keep_best = keep_best
+
+    def _metric_args(self, entry):
+        sanitize = re.compile(r"[\./\\\?!:-]")
+        metrics = entry.metrics or {}
+        return {"m_" + sanitize.sub("_", k): v for k, v in metrics.items()}
+
+    def _iter_args(self, entry):
+        return {
+            "id_model": entry.model,
+            "n_stage": entry.idx_stage,
+            "n_epoch": entry.idx_epoch,
+            "n_steps": entry.idx_step,
+        }
+
+    def _args(self, entry):
+        return self._iter_args(entry) | self._metric_args(entry)
+
+    def _sort_key_best(self, entry):
+        args = self._args(entry)
+        return [utils.expr.eval_math_expr(c, args) for c in self.compare]
+
+    @staticmethod
+    def _sort_key_latest(entry):
+        return entry.idx_stage, entry.idx_epoch, entry.idx_step
+
+    def _filtered(self, stage, epoch):
+        chkpts = self.checkpoints
+        if stage is not None and epoch is not None:
+            return [c for c in chkpts if c.idx_stage == stage and c.idx_epoch == epoch]
+        if stage is not None:
+            return [c for c in chkpts if c.idx_stage == stage]
+        if epoch is not None:
+            raise ValueError("epoch can only be set if stage is set")
+        return chkpts
+
+    def get_best(self, stage=None, epoch=None) -> Optional[CheckpointEntry]:
+        return min(self._filtered(stage, epoch), key=self._sort_key_best, default=None)
+
+    def get_latest(self, stage=None, epoch=None) -> Optional[CheckpointEntry]:
+        return max(self._filtered(stage, epoch), key=self._sort_key_latest,
+                   default=None)
+
+    def trim(self, n_best=1, n_latest=1, delete=True):
+        if n_best is None and n_latest is None:
+            return
+
+        keep, remove = set(), set()
+        for s in {c.idx_stage for c in self.checkpoints}:
+            chkpts = [c for c in self.checkpoints if c.idx_stage == s]
+
+            if n_best is not None:
+                best = sorted(chkpts, key=self._sort_key_best)
+                keep |= set(best[:n_best])
+                remove |= set(best[n_best:])
+
+            if n_latest is not None:
+                latest = sorted(chkpts, key=self._sort_key_latest, reverse=True)
+                keep |= set(latest[:n_latest])
+                remove |= set(latest[n_latest:])
+
+        self.checkpoints = sorted(keep, key=self._sort_key_latest)
+
+        if delete:
+            for entry in remove - keep:
+                entry.path.unlink(missing_ok=True)
+
+    def create(self, log, ctx, stage, epoch, step, metrics):
+        """Save a checkpoint from the live training context and trim."""
+        epoch_int = epoch if epoch is not None else stage.data.epochs
+        entry = CheckpointEntry(self.model_id, stage.index, epoch_int, step,
+                                metrics, None)
+
+        args = self._args(entry) | {"id_stage": stage.id}
+        args["id_model"] = args["id_model"].replace("/", "_").replace("-", ".")
+        args["id_stage"] = args["id_stage"].replace("/", "_").replace("-", ".")
+
+        entry.path = self.path / self.name.format_map(args)
+        entry.path.parent.mkdir(parents=True, exist_ok=True)
+
+        log.debug(f"saving checkpoint to '{entry.path}'")
+
+        chkpt = Checkpoint(
+            model=self.model_id,
+            iteration=Iteration(stage.index, epoch, step),
+            metrics=metrics,
+            state=State(
+                model=serialization.to_state_dict(_to_host(ctx.train_variables())),
+                optimizer=serialization.to_state_dict(_to_host(ctx.opt_state())),
+                scaler=dict(ctx.scaler or {}),
+                lr_sched_inst=[s.state_dict() for s in ctx.lr_sched_inst or []],
+                lr_sched_epoch=[s.state_dict() for s in ctx.lr_sched_epoch or []],
+            ),
+            metadata={
+                "timestamp": datetime.now().isoformat(),
+                "source": "training",
+            },
+        )
+        chkpt.save(entry.path)
+
+        self.checkpoints.append(entry)
+        self.trim(n_best=self.keep_best, n_latest=self.keep_latest)
+
+
+def load_directory(path, compare) -> List[CheckpointManager]:
+    """Scan a directory into per-model CheckpointManagers."""
+    name = "{id_model}-s{n_stage}_e{n_epoch}_b{n_steps}.ckpt"
+    path = Path(path)
+
+    checkpoints = defaultdict(list)
+    for file in sorted(path.iterdir()):
+        if not file.is_file():
+            continue
+        try:
+            entry = Checkpoint.load(file).to_entry(file)
+        except (ValueError, KeyError):
+            continue
+        checkpoints[entry.model].append(entry)
+
+    mgrs = []
+    for model in sorted(checkpoints):
+        mgr = CheckpointManager(model, path, name, compare)
+        mgr.checkpoints = checkpoints[model]
+        mgrs.append(mgr)
+
+    return mgrs

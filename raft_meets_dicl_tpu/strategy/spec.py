@@ -1,0 +1,558 @@
+"""Training strategy specification: stages, optimizers, schedulers, gradients.
+
+Config-compatible with the reference (src/strategy/spec.py) — same YAML
+surface (``adam``/``adam-w``/``sgd`` with torch-style parameter names,
+``one-cycle``/``multi-step`` schedulers with expression-evaluated
+parameters, gradient accumulate/clip/scaler) — but built on optax:
+
+- the optimizer spec builds an optax gradient-transform chain
+  (torch ``Adam(weight_decay=...)``'s L2-into-grad semantics map to
+  ``add_decayed_weights`` *before* ``scale_by_adam``; ``adam-w`` maps to
+  decay *after*),
+- gradient clipping is a transform in that chain,
+- gradient accumulation wraps the chain in ``optax.MultiSteps``,
+- learning-rate schedulers are small host-side stateful objects (their
+  state checkpoints like torch schedulers); the current LR is injected
+  into the jitted step through ``optax.inject_hyperparams``,
+- the AMP ``GradScaler`` spec is kept for config parity but builds a no-op
+  state: bf16 on TPU needs no loss scaling.
+"""
+
+from typing import List, Optional
+
+import numpy as np
+import optax
+
+from .. import data, utils
+
+
+class DataSpec:
+    @classmethod
+    def from_config(cls, path, cfg):
+        return cls(
+            source=data.load(path, cfg["source"]),
+            epochs=int(cfg.get("epochs", 1)),
+            batch_size=int(cfg.get("batch-size", 1)),
+            drop_last=bool(cfg.get("drop-last", True)),
+            shuffle=bool(cfg.get("shuffle", True)),
+        )
+
+    def __init__(self, source, epochs, batch_size, drop_last=True, shuffle=True):
+        self.source = source
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        self.shuffle = shuffle
+
+    def get_config(self):
+        return {
+            "source": self.source.get_config(),
+            "epochs": self.epochs,
+            "batch-size": self.batch_size,
+            "drop-last": self.drop_last,
+            "shuffle": self.shuffle,
+        }
+
+
+class ValidationSpec:
+    @classmethod
+    def from_config(cls, path, cfg):
+        if cfg is None:
+            return None
+
+        return cls(
+            name=cfg.get("name", "default"),
+            source=data.load(path, cfg["source"]),
+            batch_size=int(cfg.get("batch-size", 1)),
+            images=set(cfg.get("images", {})),
+        )
+
+    def __init__(self, name, source, batch_size, images):
+        self.name = name
+        self.source = source
+        self.batch_size = batch_size
+        self.images = images
+
+    def get_config(self):
+        return {
+            "name": self.name,
+            "source": self.source.get_config(),
+            "batch-size": self.batch_size,
+            "images": list(self.images),
+        }
+
+
+class OptimizerSpec:
+    """torch-style optimizer config → optax transform chain.
+
+    Parameter-name translation (lr, betas, eps, weight_decay, momentum)
+    happens here so reference configs work verbatim.
+    """
+
+    def __init__(self, type, parameters={}):
+        self.type = type
+        self.parameters = dict(parameters)
+
+    @classmethod
+    def from_config(cls, cfg):
+        return cls(cfg["type"], cfg.get("parameters", {}))
+
+    def get_config(self):
+        return {"type": self.type, "parameters": self.parameters}
+
+    def build_transform(self):
+        """The core optimizer as an optax transform WITHOUT the lr scale.
+
+        Returns ``(transform, base_lr)``. The train step multiplies the
+        produced updates by ``-lr`` itself, so host-side stateful schedulers
+        can drive the rate without rebuilding the optimizer state (the
+        resumable analog of torch schedulers mutating ``optimizer.lr``).
+        """
+        p = dict(self.parameters)
+        lr = float(p.pop("lr", 1e-3))
+
+        if self.type == "adam":
+            b1, b2 = p.pop("betas", (0.9, 0.999))
+            eps = float(p.pop("eps", 1e-8))
+            wd = float(p.pop("weight_decay", 0.0))
+
+            steps = []
+            if wd:
+                # torch Adam folds L2 into the gradient before moments
+                steps.append(optax.add_decayed_weights(wd))
+            steps.append(optax.scale_by_adam(b1=b1, b2=b2, eps=eps))
+            tx = optax.chain(*steps)
+
+        elif self.type == "adam-w":
+            b1, b2 = p.pop("betas", (0.9, 0.999))
+            eps = float(p.pop("eps", 1e-8))
+            wd = float(p.pop("weight_decay", 1e-2))
+
+            tx = optax.chain(
+                optax.scale_by_adam(b1=b1, b2=b2, eps=eps),
+                optax.add_decayed_weights(wd),
+            )
+
+        elif self.type == "sgd":
+            momentum = float(p.pop("momentum", 0.0))
+            wd = float(p.pop("weight_decay", 0.0))
+            nesterov = bool(p.pop("nesterov", False))
+
+            steps = []
+            if wd:
+                steps.append(optax.add_decayed_weights(wd))
+            if momentum:
+                steps.append(optax.trace(decay=momentum, nesterov=nesterov))
+            tx = optax.chain(*steps) if steps else optax.identity()
+
+        else:
+            raise ValueError(f"unknown optimizer type '{self.type}'")
+
+        if p:
+            raise ValueError(f"unsupported optimizer parameters: {sorted(p)}")
+
+        return tx, lr
+
+    def build(self, gradient=None):
+        """Full per-stage transform: clip → optimizer core (→ MultiSteps).
+
+        Returns ``(tx, base_lr)``; ``gradient`` is the stage GradientSpec.
+        """
+        core, lr = self.build_transform()
+
+        steps = []
+        if gradient is not None and gradient.clip is not None:
+            steps.append(gradient.clip.build_transform())
+        steps.append(core)
+        tx = optax.chain(*steps)
+
+        if gradient is not None and gradient.accumulate > 1:
+            tx = optax.MultiSteps(tx, every_k_schedule=gradient.accumulate)
+
+        return tx, lr
+
+
+class ClipGradient:
+    type = None
+
+    @classmethod
+    def from_config(cls, cfg):
+        if cfg is None:
+            return None
+
+        types = {c.type: c for c in (ClipGradientNorm, ClipGradientValue)}
+        return types[cfg["type"]]._from_config(cfg)
+
+    @classmethod
+    def _typecheck(cls, cfg):
+        if cfg["type"] != cls.type:
+            raise ValueError(
+                f"invalid gradient clip type '{cfg['type']}', expected '{cls.type}'"
+            )
+
+    def get_config(self):
+        raise NotImplementedError
+
+    def build_transform(self):
+        raise NotImplementedError
+
+
+class ClipGradientNorm(ClipGradient):
+    """Clip by global gradient norm (any ord; l2 uses the optax builtin)."""
+
+    type = "norm"
+
+    @classmethod
+    def _from_config(cls, cfg):
+        cls._typecheck(cfg)
+        return cls(cfg["value"], float(cfg.get("ord", 2)))
+
+    def __init__(self, value, ord=2.0):
+        self.value = value
+        self.ord = ord
+
+    def get_config(self):
+        ord_ = self.ord if self.ord not in (np.inf, -np.inf) else str(self.ord)
+        return {"type": self.type, "value": self.value, "ord": ord_}
+
+    def build_transform(self):
+        if self.ord == 2.0:
+            return optax.clip_by_global_norm(self.value)
+
+        value, ord_ = self.value, self.ord
+
+        def clip_by_ord(updates, state, params=None):
+            import jax
+            import jax.numpy as jnp
+
+            flat = jnp.concatenate(
+                [jnp.abs(x).ravel() for x in jax.tree.leaves(updates)]
+            )
+            norm = jnp.linalg.norm(flat, ord=ord_)
+            scale = jnp.minimum(1.0, value / jnp.maximum(norm, 1e-12))
+            return jax.tree.map(lambda x: x * scale, updates), state
+
+        return optax.GradientTransformation(lambda params: optax.EmptyState(), clip_by_ord)
+
+
+class ClipGradientValue(ClipGradient):
+    type = "value"
+
+    @classmethod
+    def _from_config(cls, cfg):
+        cls._typecheck(cfg)
+        return cls(float(cfg["value"]))
+
+    def __init__(self, value):
+        self.value = value
+
+    def get_config(self):
+        return {"type": self.type, "value": self.value}
+
+    def build_transform(self):
+        return optax.clip(self.value)
+
+
+class GradientScalerSpec:
+    """AMP GradScaler config, kept for parity; a no-op on TPU (bf16)."""
+
+    @classmethod
+    def from_config(cls, cfg):
+        if cfg is None:
+            return cls(enabled=False)
+
+        return cls(
+            enabled=bool(cfg.get("enabled", True)),
+            init_scale=float(cfg.get("init-scale", 65536.0)),
+            growth_factor=float(cfg.get("growth-factor", 2.0)),
+            backoff_factor=float(cfg.get("backoff-factor", 0.5)),
+            growth_interval=int(cfg.get("growth-interval", 2000)),
+        )
+
+    def __init__(self, enabled=False, init_scale=65536.0, growth_factor=2.0,
+                 backoff_factor=0.5, growth_interval=2000):
+        self.enabled = enabled
+        self.init_scale = init_scale
+        self.growth_factor = growth_factor
+        self.backoff_factor = backoff_factor
+        self.growth_interval = growth_interval
+
+    def get_config(self):
+        return {
+            "enabled": self.enabled,
+            "init-scale": self.init_scale,
+            "growth-factor": self.growth_factor,
+            "backoff-factor": self.backoff_factor,
+            "growth-interval": self.growth_interval,
+        }
+
+    def build(self):
+        # state kept so checkpoints round-trip the scaler slot like the
+        # reference; no loss scaling happens on TPU
+        return {"enabled": self.enabled, "scale": self.init_scale}
+
+
+class GradientSpec:
+    @classmethod
+    def from_config(cls, cfg):
+        return cls(
+            accumulate=int(cfg.get("accumulate", 1)),
+            clip=ClipGradient.from_config(cfg.get("clip")),
+            scaler=GradientScalerSpec.from_config(cfg.get("scaler")),
+        )
+
+    def __init__(self, accumulate=1, clip=None, scaler=None):
+        if accumulate < 1:
+            raise ValueError(f"invalid value for GradientSpec.accumulate: {accumulate}")
+
+        self.accumulate = accumulate
+        self.clip = clip
+        self.scaler = scaler if scaler is not None else GradientScalerSpec()
+
+    def get_config(self):
+        return {
+            "accumulate": self.accumulate,
+            "clip": self.clip.get_config() if self.clip is not None else None,
+            "scaler": self.scaler.get_config(),
+        }
+
+
+# -- learning-rate schedulers ----------------------------------------------
+
+
+class LrScheduler:
+    """Host-side stateful scheduler with torch-like step semantics.
+
+    ``lr()`` returns the rate for the *next* optimizer update; ``step()``
+    advances. State round-trips via ``state_dict``/``load_state_dict`` for
+    checkpointing.
+    """
+
+    def __init__(self, base_lr):
+        self.base_lr = base_lr
+        self.last_step = 0
+
+    def lr(self):
+        raise NotImplementedError
+
+    def step(self):
+        self.last_step += 1
+
+    def state_dict(self):
+        return {"last_step": self.last_step}
+
+    def load_state_dict(self, state):
+        self.last_step = int(state["last_step"])
+
+
+class OneCycleLr(LrScheduler):
+    """torch OneCycleLR: warmup to max_lr, anneal to max_lr/div/final_div."""
+
+    def __init__(self, base_lr, max_lr, total_steps, pct_start=0.3,
+                 anneal_strategy="cos", div_factor=25.0, final_div_factor=1e4,
+                 cycle_momentum=True, base_momentum=0.85, max_momentum=0.95,
+                 three_phase=False):
+        super().__init__(base_lr)
+
+        if three_phase:
+            raise NotImplementedError("three_phase one-cycle is not supported")
+
+        self.max_lr = float(max_lr)
+        self.total_steps = int(total_steps)
+        self.pct_start = float(pct_start)
+        self.anneal_strategy = anneal_strategy
+        self.div_factor = float(div_factor)
+        self.final_div_factor = float(final_div_factor)
+        # momentum cycling is accepted for config parity but not applied
+        self.cycle_momentum = cycle_momentum
+
+        self.initial_lr = self.max_lr / self.div_factor
+        self.min_lr = self.initial_lr / self.final_div_factor
+
+    def _anneal(self, start, end, pct):
+        if self.anneal_strategy == "linear":
+            return start + (end - start) * pct
+        # 'cos'
+        return end + (start - end) / 2.0 * (1.0 + np.cos(np.pi * pct))
+
+    def lr(self):
+        up_steps = float(self.pct_start * self.total_steps) - 1.0
+        down_steps = float(self.total_steps - up_steps) - 1.0
+
+        step = min(self.last_step, self.total_steps - 1)
+        if step <= up_steps:
+            return self._anneal(self.initial_lr, self.max_lr, step / max(up_steps, 1))
+        return self._anneal(
+            self.max_lr, self.min_lr, (step - up_steps) / max(down_steps, 1)
+        )
+
+
+class MultiStepLr(LrScheduler):
+    """torch MultiStepLR: multiply by gamma at each milestone."""
+
+    def __init__(self, base_lr, milestones, gamma=0.1):
+        super().__init__(base_lr)
+        self.milestones = sorted(int(m) for m in milestones)
+        self.gamma = float(gamma)
+
+    def lr(self):
+        passed = sum(1 for m in self.milestones if m <= self.last_step)
+        return self.base_lr * self.gamma**passed
+
+
+class SchedulerSpec:
+    """Typed scheduler config with expression-evaluated parameters.
+
+    Expressions may reference ``n_samples``, ``n_batches``, ``n_epochs``,
+    ``n_accum``, ``batch_size`` (reference src/strategy/training.py:158-164).
+    """
+
+    _TYPES = {"one-cycle": OneCycleLr, "multi-step": MultiStepLr}
+
+    @classmethod
+    def from_config(cls, cfg):
+        return cls(cfg["type"], cfg.get("parameters", {}))
+
+    def __init__(self, type, parameters={}):
+        if type not in self._TYPES:
+            raise ValueError(f"unknown scheduler type '{type}'")
+        self.type = type
+        self.parameters = dict(parameters)
+
+    def get_config(self):
+        return {"type": self.type, "parameters": self.parameters}
+
+    def _eval_param(self, value, vars):
+        if isinstance(value, dict):
+            return {k: self._eval_param(v, vars) for k, v in value.items()}
+        if isinstance(value, (tuple, list)):
+            return [self._eval_param(v, vars) for v in value]
+        if not isinstance(value, str):
+            return value
+        try:
+            return utils.expr.eval_math_expr(value, vars)
+        except (TypeError, ValueError, KeyError, IndexError):
+            # not an expression (e.g. 'linear', 'cos') — pass through
+            return value
+
+    def build(self, base_lr, variables):
+        params = {k: self._eval_param(v, variables) for k, v in self.parameters.items()}
+
+        if self.type == "one-cycle":
+            max_lr = params.pop("max_lr", base_lr)
+            return OneCycleLr(base_lr, max_lr, **params)
+        return MultiStepLr(base_lr, **params)
+
+
+class MultiSchedulerSpec:
+    """Instance-level (per optimizer update) + epoch-level scheduler lists."""
+
+    @classmethod
+    def from_config(cls, cfg):
+        return cls(
+            instance=[SchedulerSpec.from_config(c) for c in cfg.get("instance", [])],
+            epoch=[SchedulerSpec.from_config(c) for c in cfg.get("epoch", [])],
+        )
+
+    def __init__(self, instance=[], epoch=[]):
+        self.instance = list(instance)
+        self.epoch = list(epoch)
+
+    def get_config(self):
+        return {
+            "instance": [s.get_config() for s in self.instance],
+            "epoch": [s.get_config() for s in self.epoch],
+        }
+
+    def build(self, base_lr, variables):
+        return (
+            [s.build(base_lr, variables) for s in self.instance],
+            [s.build(base_lr, variables) for s in self.epoch],
+        )
+
+
+# -- stage / strategy -------------------------------------------------------
+
+
+class Stage:
+    @classmethod
+    def from_config(cls, path, cfg):
+        valid = cfg.get("validation", [])
+        if isinstance(valid, dict):
+            valid = [valid]
+
+        return cls(
+            name=cfg["name"],
+            id=cfg["id"],
+            data=DataSpec.from_config(path, cfg["data"]),
+            validation=[ValidationSpec.from_config(path, v) for v in valid],
+            optimizer=OptimizerSpec.from_config(cfg["optimizer"]),
+            model_args=cfg.get("model", {}).get("arguments", {}),
+            model_on_epoch_args=cfg.get("model", {}).get("on-epoch", {}),
+            model_on_stage_args=cfg.get("model", {}).get("on-stage", {}),
+            loss_args=cfg.get("loss", {}).get("arguments", {}),
+            gradient=GradientSpec.from_config(cfg.get("gradient", {})),
+            scheduler=MultiSchedulerSpec.from_config(cfg.get("lr-scheduler", {})),
+            loader_args=cfg.get("loader", {}),
+        )
+
+    def __init__(self, name, id, data, validation, optimizer, model_args={},
+                 model_on_epoch_args={}, model_on_stage_args={}, loss_args={},
+                 gradient=None, scheduler=None, loader_args={}):
+        self.name = name
+        self.id = id
+        self.data = data
+        self.validation = validation
+        self.optimizer = optimizer
+        self.model_args = dict(model_args)
+        self.model_on_epoch_args = dict(model_on_epoch_args)
+        self.model_on_stage_args = dict(model_on_stage_args)
+        self.loss_args = dict(loss_args)
+        self.gradient = gradient if gradient is not None else GradientSpec()
+        self.scheduler = scheduler if scheduler is not None else MultiSchedulerSpec()
+        self.loader_args = dict(loader_args)
+        self.index = 0  # set by the training loop
+
+    def get_config(self):
+        return {
+            "name": self.name,
+            "id": self.id,
+            "data": self.data.get_config(),
+            "validation": [v.get_config() for v in self.validation],
+            "optimizer": self.optimizer.get_config(),
+            "model": {
+                "arguments": self.model_args,
+                "on-epoch": self.model_on_epoch_args,
+                "on-stage": self.model_on_stage_args,
+            },
+            "loss": {"arguments": self.loss_args},
+            "gradient": self.gradient.get_config(),
+            "lr-scheduler": self.scheduler.get_config(),
+            "loader": self.loader_args,
+        }
+
+
+class Strategy:
+    """mode ``best`` restores the best checkpoint of the previous stage at
+    each stage start; ``continuous`` keeps training the live weights."""
+
+    mode: str
+    stages: List[Stage]
+
+    @classmethod
+    def from_config(cls, path, cfg):
+        from . import config as strategy_config
+
+        mode = cfg.get("mode", "best")
+        if mode not in ("best", "continuous"):
+            raise ValueError("invalid value for mode, expected one of ['best', 'continuous']")
+
+        stages = [strategy_config.load_stage(path, c) for c in cfg["stages"]]
+        return cls(mode, stages)
+
+    def __init__(self, mode, stages):
+        self.mode = mode
+        self.stages = stages
+
+    def get_config(self):
+        return {"mode": self.mode, "stages": [s.get_config() for s in self.stages]}

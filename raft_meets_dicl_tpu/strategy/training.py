@@ -1,0 +1,382 @@
+"""The training loop: stages → epochs → instances, on a jitted SPMD step.
+
+Control-flow parity with the reference TrainingContext
+(src/strategy/training.py:17-325): resume arithmetic, ``mode='best'``
+cross-stage checkpoint promotion, per-stage optimizer/scheduler rebuilds
+(checkpoints restore weights-only at stage boundaries, full state
+mid-stage), invalid-batch skipping, result validation with a ``failed``
+checkpoint dump, and the 9-callback Inspector protocol.
+
+The hot path is different by design: instead of eager torch ops, each
+instance calls one jitted train step (parallel.make_train_step) that holds
+the whole forward/backward/update program; gradient accumulation and
+clipping live inside it as optax transforms. Per-instance host work is just
+the scheduler tick, callbacks, and a scalar fetch (loss + finiteness).
+"""
+
+from datetime import datetime
+from pathlib import Path
+from typing import Optional
+
+import jax
+import numpy as np
+
+from .. import utils
+from ..parallel import TrainState, make_train_step, replicate, shard_batch
+from .checkpoint import Checkpoint, Iteration, State
+from .spec import Stage, Strategy
+
+
+class _StepResult:
+    """Minimal Result view over the train step's aux outputs."""
+
+    def __init__(self, aux):
+        self.aux = aux
+
+    def final(self):
+        return self.aux["final"]
+
+    def output(self, batch_index=None):
+        return self.aux["final"]
+
+    def intermediate_flow(self):
+        return [self.aux["final"]]
+
+
+class TrainingContext:
+    def __init__(self, log, path, strategy, model_id, model, model_adapter,
+                 loss, input, inspector, checkpoints, mesh=None,
+                 step_limit=None, loader_args={}):
+        self.root_log = log
+        self.log = log
+        self.path = Path(path)
+        self.strategy = strategy
+        self.model_id = model_id
+        self.model = model
+        self.model_adapter = model_adapter
+        self.loss = loss
+        self.input = input
+        self.inspector = inspector
+        self.checkpoints = checkpoints
+        self.mesh = mesh
+        self.loader_args = dict(loader_args)
+
+        self.validate = True
+
+        self.step = 0
+        self.step_limit = step_limit
+
+        # per-run / per-stage state
+        self.variables = None       # model variables when no stage is active
+        self.state: Optional[TrainState] = None
+        self.tx = None
+        self.scaler = None
+        self.lr_sched_inst = None
+        self.lr_sched_epoch = None
+        self.data = None
+        self.step_fn = None
+        self.base_lr = 0.0
+        self.current_stage = None
+        self.current_epoch = None
+        self.last_lr = 0.0
+
+    # -- state accessors (used by CheckpointManager.create) ----------------
+
+    def train_variables(self):
+        if self.state is not None:
+            return {"params": self.state.params,
+                    "batch_stats": self.state.batch_stats}
+        return self.variables
+
+    def opt_state(self):
+        return self.state.opt_state if self.state is not None else {}
+
+    # -- initialization ----------------------------------------------------
+
+    def _ensure_variables(self, stage):
+        """Initialize model variables from the first stage's sample shape."""
+        if self.variables is not None:
+            return
+
+        self.log.info("initializing model parameters")
+        img1, img2, *_ = self.input.apply(stage.data.source).jax()[0]
+
+        rng = jax.random.PRNGKey(int(np.random.randint(0, 2**31 - 1)))
+        init_args = dict(self.model.arguments)
+        # keep tracing cheap: recurrent iteration counts don't affect params
+        if "iterations" in init_args:
+            init_args["iterations"] = (
+                1 if isinstance(init_args["iterations"], int)
+                else tuple(1 for _ in init_args["iterations"])
+            )
+
+        self.variables = self.model.init(
+            rng, img1[:1], img2[:1], **init_args
+        )
+
+    # -- main loop ----------------------------------------------------------
+
+    def run(self, start_stage=None, start_epoch=None, checkpoint=None):
+        n_stages = len(self.strategy.stages)
+
+        if start_stage is None and checkpoint is not None:
+            start_stage = checkpoint.iteration.stage
+        if start_stage is None:
+            start_stage = 0
+
+        assert 0 <= start_stage < n_stages
+
+        if start_epoch is None and checkpoint is not None:
+            start_epoch = checkpoint.iteration.epoch + 1
+        if start_epoch is None:
+            start_epoch = 0
+
+        if checkpoint is not None:
+            self.step = checkpoint.iteration.step
+
+        backend = jax.default_backend()
+        self.log.info(
+            f"start training: running {n_stages} stages on backend "
+            f"'{backend}' ({jax.device_count()} devices)"
+        )
+
+        self._ensure_variables(self.strategy.stages[start_stage])
+        self.inspector.setup(self.log, self)
+
+        for i, stage in list(enumerate(self.strategy.stages))[start_stage:]:
+            # checkpoint created at end of a stage: skip to the next
+            if start_epoch >= stage.data.epochs:
+                start_epoch = 0
+                continue
+
+            self.log = self.root_log.new(f"stage {i + 1}/{n_stages}")
+            self.log.info(
+                f"starting new stage '{stage.name}' ({stage.id}) at step {self.step}"
+            )
+
+            stage.index = i
+            self.run_stage(self.log, stage, start_epoch, checkpoint)
+
+            start_epoch = 0
+            checkpoint = None
+
+            if self.step_limit is not None and self.step >= self.step_limit:
+                break
+
+        self.log = self.root_log
+        self.log.info(
+            f"training loop complete, ran {self.step:,} steps over {n_stages} stages"
+        )
+
+    def prepare_stage(self, log, stage: Stage):
+        if self.strategy.mode != "best":
+            return
+
+        chkpt = self.checkpoints.get_best(stage=stage.index - 1)
+        if chkpt is None:
+            return
+
+        log.info(f"loading best checkpoint from previous stage, file='{chkpt.path}'")
+        chkpt = chkpt.load()
+        self.variables, _, _ = chkpt.apply(variables=self.variables)
+
+    def run_stage(self, log, stage: Stage, start_epoch=0, checkpoint=None):
+        assert 0 <= start_epoch < stage.data.epochs
+
+        self.current_stage = stage
+        self.prepare_stage(log, stage)
+
+        # data
+        log.info(f"loading dataset: {stage.data.source.description()}")
+        loader_args = self.loader_args | stage.loader_args
+
+        input = self.input.apply(stage.data.source).jax()
+        self.data = input.loader(
+            batch_size=stage.data.batch_size,
+            shuffle=stage.data.shuffle,
+            drop_last=stage.data.drop_last,
+            **loader_args,
+        )
+        log.info(
+            f"dataset loaded: have {len(self.data)} batches over {len(input)} samples"
+        )
+
+        # optimizer (fresh per stage, like the reference)
+        log.info("setting up optimizer")
+        self.tx, self.base_lr = stage.optimizer.build(stage.gradient)
+        self.scaler = stage.gradient.scaler.build()
+
+        sched_vars = {
+            "n_samples": len(input),
+            "n_batches": len(self.data),
+            "n_epochs": stage.data.epochs,
+            "n_accum": stage.gradient.accumulate,
+            "batch_size": stage.data.batch_size,
+        }
+        self.lr_sched_inst, self.lr_sched_epoch = stage.scheduler.build(
+            self.base_lr, sched_vars
+        )
+
+        # state: fresh optimizer, current weights
+        self.state = TrainState.create(self.variables, self.tx)
+
+        # restore checkpoint state: stage boundary (epoch 0) restores weights
+        # only — optimizer/schedulers belong to the previous stage
+        if checkpoint is not None:
+            log.info("restoring data from checkpoint")
+            if start_epoch == 0:
+                variables, _, _ = checkpoint.apply(
+                    variables=self.train_variables()
+                )
+                self.state = TrainState.create(variables, self.tx)
+            else:
+                variables, opt_state, self.scaler = checkpoint.apply(
+                    variables=self.train_variables(),
+                    opt_state=self.state.opt_state,
+                    scaler=self.scaler,
+                    lr_sched_inst=self.lr_sched_inst,
+                    lr_sched_epoch=self.lr_sched_epoch,
+                )
+                self.state = self.state.replace(
+                    params=variables["params"],
+                    batch_stats=variables["batch_stats"],
+                    opt_state=opt_state,
+                )
+
+        if self.mesh is not None:
+            self.state = replicate(self.state, self.mesh)
+
+        # stage hooks before building the step: freeze_batchnorm etc. are
+        # baked into the compiled program
+        self.model_adapter.on_stage(stage, **stage.model_on_stage_args)
+
+        self.step_fn = make_train_step(
+            self.model, self.loss, self.tx, mesh=self.mesh,
+            loss_args=stage.loss_args, model_args=stage.model_args,
+            external_lr=True, donate=True,
+        )
+
+        self.inspector.on_stage_start(log, self, stage)
+
+        log.info(f"running {stage.data.epochs} epochs")
+        for epoch in range(start_epoch, stage.data.epochs):
+            log_ = log.new(f"epoch {epoch + 1}/{stage.data.epochs}", sep=", ")
+            log_.info(f"starting new epoch at step {self.step}")
+            self.log = log_
+
+            self.run_epoch(log_, stage, epoch)
+
+            if self.step_limit is not None and self.step >= self.step_limit:
+                break
+
+        self.log = log
+
+        # sync live variables out of the stage state
+        self.variables = self.train_variables()
+
+        self.inspector.on_stage(log, self, stage)
+
+    def run_epoch(self, log, stage, epoch):
+        self.current_epoch = epoch
+
+        desc = (
+            f"stage {stage.index + 1}/{len(self.strategy.stages)}, "
+            f"epoch {epoch + 1}/{stage.data.epochs}"
+        )
+        samples = utils.logging.progress(self.data, unit="batch", leave=False,
+                                         desc=desc)
+
+        self.model_adapter.on_epoch(stage, epoch, **stage.model_on_epoch_args)
+        self.inspector.on_epoch_start(log, self, stage, epoch)
+
+        for i, (img1, img2, flow, valid, meta) in enumerate(samples):
+            log_ = log.new(f"step {self.step}", sep=", ")
+            self.log = log_
+
+            self.run_instance(log_, stage, epoch, i, img1, img2, flow, valid, meta)
+
+            if self.step_limit is not None and self.step >= self.step_limit:
+                break
+
+        self.log = log
+
+        for s in self.lr_sched_epoch:
+            s.step()
+
+        self.inspector.on_epoch(log, self, stage, epoch)
+
+    def run_instance(self, log, stage, epoch, i, img1, img2, flow, valid, meta):
+        accumulate = stage.gradient.accumulate
+
+        if i % accumulate == 0:
+            self.inspector.on_step_start(log, self, stage, epoch, i)
+
+        # check for degeneracies in samples and warn/skip
+        if not all(m.valid for m in meta):
+            log.warn("skipping batch due to invalid data")
+            return
+
+        # learning rate from the instance schedulers (last one wins, like
+        # chained torch schedulers); epoch schedulers compose the base
+        lr = self.base_lr
+        for s in self.lr_sched_epoch:
+            lr = s.lr()
+        for s in self.lr_sched_inst:
+            lr = s.lr()
+        self.last_lr = lr
+
+        batch = (img1, img2, flow, valid)
+        if self.mesh is not None:
+            batch = shard_batch(batch, self.mesh)
+
+        self.inspector.on_batch_start(log, self, stage, epoch, i, img1, img2,
+                                      flow, valid, meta)
+
+        self.state, aux = self.step_fn(self.state, lr, *batch)
+
+        # validate output, check for non-finite numbers
+        if self.validate and not bool(aux["finite"]):
+            self._dump_failed(log, stage, epoch)
+            raise RuntimeError("non-finite flow values detected")
+
+        loss = aux["loss"]
+        result = _StepResult(aux)
+
+        self.inspector.on_batch(log, self, stage, epoch, i, img1, img2, flow,
+                                valid, meta, result, loss)
+
+        if (i + 1) % accumulate == 0:
+            # the optimizer update itself happened inside the jitted step
+            # (optax.MultiSteps applies on every accumulate-th call)
+            for s in self.lr_sched_inst:
+                s.step()
+
+            self.inspector.on_step_end(log, self, stage, epoch, i)
+            self.step += 1
+
+    def _dump_failed(self, log, stage, epoch):
+        log.error("detected non-finite values in final flow field")
+
+        from flax import serialization
+
+        chkpt = Checkpoint(
+            model=self.model_id,
+            iteration=Iteration(stage.index, epoch, self.step),
+            metrics=None,
+            state=State(
+                model=serialization.to_state_dict(
+                    jax.tree.map(np.asarray, self.train_variables())
+                ),
+                optimizer=serialization.to_state_dict(
+                    jax.tree.map(np.asarray, self.opt_state())
+                ),
+                scaler=dict(self.scaler or {}),
+                lr_sched_inst=[s.state_dict() for s in self.lr_sched_inst],
+                lr_sched_epoch=[s.state_dict() for s in self.lr_sched_epoch],
+            ),
+            metadata={
+                "timestamp": datetime.now().isoformat(),
+                "source": "training",
+            },
+        )
+        chkpt.save(self.path / "failed.ckpt")

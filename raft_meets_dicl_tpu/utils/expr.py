@@ -53,16 +53,18 @@ def _eval_node(node):
     raise ValueError(f"invalid expression node: {type(node).__name__}")
 
 
-def eval_math_expr(expr, **vars):
+def eval_math_expr(expr, args=None, **kwargs):
     """Evaluate an arithmetic expression, substituting ``{name}`` variables.
 
-    Accepts plain numbers (returned as-is) and strings. Example::
+    Accepts plain numbers (returned as-is) and strings; variables may be
+    passed as a dict (reference signature, src/utils/expr.py:5) or kwargs::
 
-        eval_math_expr('{n_epochs} * {n_batches}', n_epochs=2, n_batches=50)  # 100
+        eval_math_expr('{n_epochs} * {n_batches}', {'n_epochs': 2, 'n_batches': 50})
     """
     if isinstance(expr, (int, float)):
         return expr
 
-    expr = str(expr).format(**vars)
+    vars = dict(args or {}) | kwargs
+    expr = str(expr).format_map(vars)
     tree = ast.parse(expr, mode="eval")
     return _eval_node(tree)
