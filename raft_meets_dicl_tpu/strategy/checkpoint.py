@@ -71,10 +71,22 @@ class State:
 
 
 def _to_host(tree):
-    """Device arrays → numpy for serialization."""
+    """Device arrays → numpy for serialization.
+
+    Only array leaves are converted: flax msgpack can restore numpy numeric
+    arrays but chokes on numpy-ified str/None leaves (``np.str_`` round-trips
+    as dtype ``str256``), so non-array leaves pass through untouched.
+    """
     import jax
 
-    return jax.tree.map(np.asarray, tree)
+    def conv(leaf):
+        if isinstance(leaf, (np.str_, np.bytes_)):
+            return leaf.item()
+        if isinstance(leaf, (jax.Array, np.ndarray, np.generic)):
+            return np.asarray(leaf)
+        return leaf
+
+    return jax.tree.map(conv, tree)
 
 
 @dataclass
