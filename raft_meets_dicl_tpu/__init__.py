@@ -35,6 +35,18 @@ Layout (mirrors the reference's layer map, SURVEY.md §1, redesigned TPU-first):
 
 __version__ = "0.1.0"
 
-from . import data, models, ops, parallel, strategy, utils  # noqa: E402
+from . import (  # noqa: E402
+    data,
+    evaluation,
+    metrics,
+    models,
+    ops,
+    parallel,
+    strategy,
+    utils,
+)
 
-__all__ = ["data", "models", "ops", "parallel", "strategy", "utils"]
+__all__ = [
+    "data", "evaluation", "metrics", "models", "ops", "parallel",
+    "strategy", "utils",
+]

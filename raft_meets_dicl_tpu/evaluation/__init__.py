@@ -1,0 +1,82 @@
+"""Evaluation runtime: per-sample generator over a jitted inference step.
+
+TPU redesign of the reference evaluator (src/evaluation/evaluator.py:4-37):
+the forward pass runs as one jitted function per batch shape (model output
+pytree + final flow returned together), results are fetched to host once
+per batch, then unbatched per sample — same yield contract as the
+reference so eval commands/scripts iterate identically.
+"""
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import utils
+
+
+@dataclass
+class EvalSample:
+    """One evaluated sample: inputs, ground truth, and model output.
+
+    ``final`` is the finest full-resolution flow (H, W, 2); ``output`` is
+    the model-specific raw output for this sample (what the loss consumes),
+    already on host.
+    """
+
+    img1: np.ndarray
+    img2: np.ndarray
+    target: Optional[np.ndarray]
+    valid: Optional[np.ndarray]
+    final: np.ndarray
+    output: Any
+    meta: Any
+
+
+def make_eval_fn(model, model_args=None):
+    """Jitted ``(variables, img1, img2) -> (raw_output, final_flow)``."""
+    model_args = dict(model_args or {})
+    adapter = model.get_adapter()
+
+    @jax.jit
+    def step(variables, img1, img2):
+        out = model.apply(variables, img1, img2, train=False, **model_args)
+        result = adapter.wrap_result(out, img1.shape[1:3])
+        return out, result.final()
+
+    return step
+
+
+def evaluate(model, variables, data, model_args=None, show_progress=True):
+    """Yield an ``EvalSample`` per dataset sample.
+
+    ``data`` iterates batches ``(img1, img2, flow, valid, meta)`` in NHWC
+    numpy (a ``models.input.Loader`` or any compatible iterable).
+    Reference contract: src/evaluation/evaluator.py:4-37.
+    """
+    adapter = model.get_adapter()
+    step = make_eval_fn(model, model_args)
+
+    if show_progress:
+        data = utils.logging.progress(data, unit="batch", leave=False)
+
+    for img1, img2, flow, valid, meta in data:
+        batch = img1.shape[0]
+
+        out, final = step(variables, jnp.asarray(img1), jnp.asarray(img2))
+        out, final = jax.device_get((out, final))
+
+        result = adapter.wrap_result(out, img1.shape[1:3])
+
+        for b in range(batch):
+            yield EvalSample(
+                img1=img1[b],
+                img2=img2[b],
+                target=flow[b] if flow is not None else None,
+                valid=valid[b] if valid is not None else None,
+                final=np.asarray(final[b]),
+                output=result.output(b),
+                meta=meta[b],
+            )

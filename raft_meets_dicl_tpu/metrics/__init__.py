@@ -1,0 +1,45 @@
+"""Metric registry (reference src/metrics/): EPE, Fl-all, AAE, flow
+magnitude, loss, learning rate, gradient/parameter statistics."""
+
+from . import functional
+from .common import (
+    Collector,
+    Collectors,
+    MeanCollector,
+    Metric,
+    MetricContext,
+    Metrics,
+)
+from .flowmetrics import AverageAngularError, EndPointError, FlAll, FlowMagnitude
+from .trainmetrics import (
+    GradientMean,
+    GradientMinMax,
+    GradientNorm,
+    LearningRate,
+    Loss,
+    ParameterMean,
+    ParameterMinMax,
+    ParameterNorm,
+)
+
+__all__ = [
+    "functional",
+    "Collector",
+    "Collectors",
+    "MeanCollector",
+    "Metric",
+    "MetricContext",
+    "Metrics",
+    "AverageAngularError",
+    "EndPointError",
+    "FlAll",
+    "FlowMagnitude",
+    "GradientMean",
+    "GradientMinMax",
+    "GradientNorm",
+    "LearningRate",
+    "Loss",
+    "ParameterMean",
+    "ParameterMinMax",
+    "ParameterNorm",
+]

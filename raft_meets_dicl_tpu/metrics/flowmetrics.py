@@ -1,0 +1,94 @@
+"""Flow-quality metrics: EPE, Fl-all, AAE, flow magnitude.
+
+Config surface and key naming match the reference registry entries
+(src/metrics/epe.py, fl_all.py, aae.py, flow.py); the math lives in
+``functional`` so jitted validation steps can share it.
+"""
+
+from collections import OrderedDict
+from typing import List
+
+from . import functional as F
+from .common import Metric
+
+
+class EndPointError(Metric):
+    type = "epe"
+
+    @classmethod
+    def from_config(cls, cfg):
+        cls._typecheck(cfg)
+        key = cfg.get("key", "EndPointError/")
+        dist = list(cfg.get("distances", [1, 3, 5]))
+        return cls(dist, key)
+
+    def __init__(self, distances: List[float] = (1, 3, 5), key: str = "EndPointError/"):
+        self.distances = list(distances)
+        self.key = key
+
+    def get_config(self):
+        return {"type": self.type, "key": self.key, "distances": self.distances}
+
+    def compute(self, ctx, estimate, target, valid, loss):
+        vals = F.end_point_error(estimate, target, valid, self.distances)
+
+        result = OrderedDict()
+        result[f"{self.key}mean"] = float(vals["mean"])
+        for d in self.distances:
+            result[f"{self.key}{d}px"] = float(vals[f"{d}px"])
+        return result
+
+
+class FlAll(Metric):
+    type = "fl-all"
+
+    @classmethod
+    def from_config(cls, cfg):
+        cls._typecheck(cfg)
+        return cls(cfg.get("key", "Fl-all"))
+
+    def __init__(self, key: str = "Fl-all"):
+        self.key = key
+
+    def get_config(self):
+        return {"type": self.type, "key": self.key}
+
+    def compute(self, ctx, estimate, target, valid, loss):
+        return {self.key: float(F.fl_all(estimate, target, valid))}
+
+
+class AverageAngularError(Metric):
+    type = "aae"
+
+    @classmethod
+    def from_config(cls, cfg):
+        cls._typecheck(cfg)
+        return cls(cfg.get("key", "AverageAngularError"))
+
+    def __init__(self, key: str = "AverageAngularError"):
+        self.key = key
+
+    def get_config(self):
+        return {"type": self.type, "key": self.key}
+
+    def compute(self, ctx, estimate, target, valid, loss):
+        return {self.key: float(F.average_angular_error(estimate, target))}
+
+
+class FlowMagnitude(Metric):
+    type = "flow-magnitude"
+
+    @classmethod
+    def from_config(cls, cfg):
+        cls._typecheck(cfg)
+        return cls(cfg.get("ord", 2), cfg.get("key", "FlowMagnitude"))
+
+    def __init__(self, ord: float = 2, key: str = "FlowMagnitude"):
+        self.ord = ord
+        self.key = key
+
+    def get_config(self):
+        return {"type": self.type, "key": self.key, "ord": self.ord}
+
+    def compute(self, ctx, estimate, target, valid, loss):
+        return {self.key: float(F.flow_magnitude(estimate, self.ord))}

@@ -1,0 +1,145 @@
+"""Pure jnp metric math — usable eagerly on host arrays and under jit.
+
+The metric *classes* (flowmetrics/trainmetrics) wrap these functions behind
+the reference's config-constructible registry (src/metrics/common.py:5-41).
+Keeping the math here as pure functions lets the jitted validation/eval
+steps compute metrics on-device (scalars only cross the host boundary, the
+TPU-first design) while the eval command reuses the exact same definitions
+eagerly.
+
+Layout note: all flow tensors are NHWC — ``estimate``/``target`` are
+(..., H, W, 2) with channels last, ``valid`` is (..., H, W). The reference
+computes the same quantities on NCHW with ``dim=-3``
+(src/metrics/epe.py:39, fl_all.py:34-35).
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def masked_mean(x, valid):
+    """Mean of ``x`` over pixels where ``valid``; 0 if no pixel is valid."""
+    v = valid.astype(x.dtype)
+    return jnp.sum(x * v) / jnp.maximum(jnp.sum(v), 1.0)
+
+
+def end_point_error(estimate, target, valid, distances=(1, 3, 5)):
+    """EPE mean + accuracy-at-distance fractions over valid pixels.
+
+    Matches src/metrics/epe.py:36-52: the ``{d}px`` entries are the fraction
+    of valid pixels with EPE ≤ d (inverted bad-pixel rate).
+    """
+    epe = jnp.linalg.norm(estimate - target, ord=2, axis=-1)
+
+    out = {"mean": masked_mean(epe, valid)}
+    for d in distances:
+        out[f"{d}px"] = masked_mean((epe <= d).astype(jnp.float32), valid)
+    return out
+
+
+def fl_all(estimate, target, valid):
+    """KITTI Fl-all outlier fraction: EPE > 3px and EPE > 5% of target
+    magnitude, over valid pixels (src/metrics/fl_all.py:31-44)."""
+    epe = jnp.linalg.norm(estimate - target, ord=2, axis=-1)
+    mag = jnp.linalg.norm(target, ord=2, axis=-1)
+
+    bad = jnp.logical_and(epe > 3.0, epe > 0.05 * mag)
+    return masked_mean(bad.astype(jnp.float32), valid)
+
+
+def average_angular_error(estimate, target):
+    """Mean angular error (degrees) between spatio-temporal vectors (u,v,1).
+
+    Published definition (Barron et al.): the denominator is
+    ``sqrt(|est|²+1)·sqrt(|tgt|²+1)``. The reference's AAE deviates twice
+    (src/metrics/aae.py:32-41: NCHW channel indexing addresses the width
+    axis, and the denominator drops the per-vector +1 terms under the
+    roots); this implementation follows the published formula. Like the
+    reference, no valid-mask filtering.
+    """
+    u_est, v_est = estimate[..., 0], estimate[..., 1]
+    u_tgt, v_tgt = target[..., 0], target[..., 1]
+
+    n_est = jnp.sqrt(jnp.square(u_est) + jnp.square(v_est) + 1.0)
+    n_tgt = jnp.sqrt(jnp.square(u_tgt) + jnp.square(v_tgt) + 1.0)
+
+    cos = (u_est * u_tgt + v_est * v_tgt + 1.0) / (n_est * n_tgt)
+    cos = jnp.clip(cos, -1.0, 1.0)
+
+    return jnp.rad2deg(jnp.mean(jnp.arccos(cos)))
+
+
+def flow_magnitude(estimate, ord=2):
+    """Mean per-pixel flow-vector norm (src/metrics/flow.py:34-36)."""
+    return jnp.mean(jnp.linalg.norm(estimate, ord=ord, axis=-1))
+
+
+# -- pytree (gradient / parameter) statistics --------------------------------
+#
+# The reference walks module.named_parameters() (src/metrics/grad.py:11-47);
+# the pytree analog flattens the params/grads tree with path-joined names.
+
+def tree_named_leaves(tree):
+    """Flatten a pytree into [(dotted-path-name, leaf)] pairs."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+
+    def name(path):
+        parts = []
+        for p in path:
+            if hasattr(p, "key"):
+                parts.append(str(p.key))
+            elif hasattr(p, "idx"):
+                parts.append(str(p.idx))
+            else:
+                parts.append(str(p))
+        return ".".join(parts)
+
+    return [(name(path), leaf) for path, leaf in flat]
+
+
+def _fetch(scalars):
+    """One device→host transfer for a whole dict of on-device scalars —
+    per-leaf ``float()`` fetches would serialize the device pipeline."""
+    import jax as _jax
+
+    host = _jax.device_get(scalars)
+    return {k: float(v) for k, v in host.items()}
+
+
+def tree_norm(tree, ord=2):
+    """Per-leaf norms + 'total' (norm of the vector of norms)."""
+    named = tree_named_leaves(tree)
+    norms = {
+        name: jnp.linalg.norm(jnp.ravel(leaf), ord=ord) for name, leaf in named
+    }
+    norms = _fetch(norms)
+    norms["total"] = float(
+        jnp.linalg.norm(jnp.asarray(list(norms.values())), ord=ord)
+    )
+    return norms
+
+
+def tree_mean(tree):
+    """Per-leaf (size, mean) + size-weighted 'total'."""
+    named = tree_named_leaves(tree)
+    means = _fetch({name: jnp.mean(leaf) for name, leaf in named})
+    mean = {name: (int(leaf.size), means[name]) for name, leaf in named}
+    total_size = sum(n for n, _ in mean.values()) or 1
+    mean["total"] = (
+        total_size,
+        sum((n / total_size) * m for n, m in mean.values()),
+    )
+    return mean
+
+
+def tree_minmax(tree):
+    """Per-leaf (min, max) + overall 'total'."""
+    named = tree_named_leaves(tree)
+    lo = _fetch({name: jnp.min(leaf) for name, leaf in named})
+    hi = _fetch({name: jnp.max(leaf) for name, leaf in named})
+    mm = {name: (lo[name], hi[name]) for name, _ in named}
+    mm["total"] = (
+        min(l for l, _ in mm.values()),
+        max(h for _, h in mm.values()),
+    )
+    return mm
