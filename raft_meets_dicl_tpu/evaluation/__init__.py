@@ -35,9 +35,20 @@ class EvalSample:
     meta: Any
 
 
+# jitted eval fns memoized per (model, args) so repeated evaluate() calls —
+# e.g. a validation pass every N training steps — hit the jit cache instead
+# of re-tracing the full forward pass each time
+_EVAL_FN_CACHE = {}
+
+
 def make_eval_fn(model, model_args=None):
     """Jitted ``(variables, img1, img2) -> (raw_output, final_flow)``."""
     model_args = dict(model_args or {})
+    # repr-key the args: values may be unhashable (lists, e.g. mask_costs)
+    key = (id(model), tuple(sorted((k, repr(v)) for k, v in model_args.items())))
+    if key in _EVAL_FN_CACHE:
+        return _EVAL_FN_CACHE[key]
+
     adapter = model.get_adapter()
 
     @jax.jit
@@ -46,18 +57,21 @@ def make_eval_fn(model, model_args=None):
         result = adapter.wrap_result(out, img1.shape[1:3])
         return out, result.final()
 
+    _EVAL_FN_CACHE[key] = step
     return step
 
 
-def evaluate(model, variables, data, model_args=None, show_progress=True):
+def evaluate(model, variables, data, model_args=None, show_progress=True,
+             eval_fn=None):
     """Yield an ``EvalSample`` per dataset sample.
 
     ``data`` iterates batches ``(img1, img2, flow, valid, meta)`` in NHWC
     numpy (a ``models.input.Loader`` or any compatible iterable).
-    Reference contract: src/evaluation/evaluator.py:4-37.
+    Reference contract: src/evaluation/evaluator.py:4-37. Pass a prebuilt
+    ``eval_fn`` (from ``make_eval_fn``) to control caching explicitly.
     """
     adapter = model.get_adapter()
-    step = make_eval_fn(model, model_args)
+    step = eval_fn if eval_fn is not None else make_eval_fn(model, model_args)
 
     if show_progress:
         data = utils.logging.progress(data, unit="batch", leave=False)

@@ -100,9 +100,7 @@ def tree_named_leaves(tree):
 def _fetch(scalars):
     """One device→host transfer for a whole dict of on-device scalars —
     per-leaf ``float()`` fetches would serialize the device pipeline."""
-    import jax as _jax
-
-    host = _jax.device_get(scalars)
+    host = jax.device_get(scalars)
     return {k: float(v) for k, v in host.items()}
 
 

@@ -80,8 +80,10 @@ class _TreeMetric(Metric):
         if isinstance(self.params, dict):
             out = {}
             for group, prefixes in self.params.items():
+                # each leaf counts once even if several prefixes match, and
+                # the synthetic 'total' aggregate never joins a group
                 sel = [v for k, v in stats.items()
-                       for p in prefixes if k.startswith(p)]
+                       if k != "total" and any(k.startswith(p) for p in prefixes)]
                 if not sel:
                     raise ValueError(
                         f"metric '{self.type}': parameter group '{group}' "

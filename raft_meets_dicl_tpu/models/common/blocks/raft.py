@@ -2,7 +2,14 @@
 
 Behavioral equivalent of the reference blocks (src/models/common/blocks/
 raft.py:13-46) with kaiming-normal conv init like the reference encoders.
+
+``dtype`` is the compute dtype (bf16 under the mixed-precision policy —
+the TPU analog of the reference's autocast regions,
+src/models/impls/raft.py:377-415); params stay float32, norm statistics
+are computed in float32 inside the flax norm layers.
 """
+
+from typing import Any
 
 import flax.linen as nn
 
@@ -17,6 +24,7 @@ class ResidualBlock(nn.Module):
     out_planes: int
     norm_type: str = "group"
     stride: int = 1
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x, train=False, frozen_bn=False):
@@ -24,17 +32,18 @@ class ResidualBlock(nn.Module):
         norm_train = train and not frozen_bn
 
         y = nn.Conv(self.out_planes, (3, 3), strides=self.stride,
-                    kernel_init=kaiming_normal)(x)
-        y = Norm2d(self.norm_type, groups)(y, norm_train)
+                    kernel_init=kaiming_normal, dtype=self.dtype)(x)
+        y = Norm2d(self.norm_type, groups, dtype=self.dtype)(y, norm_train)
         y = nn.relu(y)
 
-        y = nn.Conv(self.out_planes, (3, 3), kernel_init=kaiming_normal)(y)
-        y = Norm2d(self.norm_type, groups)(y, norm_train)
+        y = nn.Conv(self.out_planes, (3, 3), kernel_init=kaiming_normal,
+                    dtype=self.dtype)(y)
+        y = Norm2d(self.norm_type, groups, dtype=self.dtype)(y, norm_train)
         y = nn.relu(y)
 
         if self.stride > 1:
             x = nn.Conv(self.out_planes, (1, 1), strides=self.stride,
-                        kernel_init=kaiming_normal)(x)
-            x = Norm2d(self.norm_type, groups)(x, norm_train)
+                        kernel_init=kaiming_normal, dtype=self.dtype)(x)
+            x = Norm2d(self.norm_type, groups, dtype=self.dtype)(x, norm_train)
 
         return nn.relu(x + y)

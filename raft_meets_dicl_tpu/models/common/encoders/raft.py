@@ -12,7 +12,7 @@ channel stages and per-level output heads (reference raft/p36.py,
 raft/common.py) returning features at 1/8..1/64.
 """
 
-from typing import Tuple
+from typing import Any, Tuple
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -25,21 +25,24 @@ class _Stem(nn.Module):
     """Input conv + the first three residual stages (to 1/8, 128ch)."""
 
     norm_type: str = "instance"
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x, train=False, frozen_bn=False):
-        x = nn.Conv(64, (7, 7), strides=2, padding=3, kernel_init=kaiming_normal)(x)
-        x = Norm2d(self.norm_type, 8)(x, train and not frozen_bn)
+        dt = self.dtype
+        x = nn.Conv(64, (7, 7), strides=2, padding=3, kernel_init=kaiming_normal,
+                    dtype=dt)(x)
+        x = Norm2d(self.norm_type, 8, dtype=dt)(x, train and not frozen_bn)
         x = nn.relu(x)
 
-        x = ResidualBlock(64, self.norm_type, stride=1)(x, train, frozen_bn)
-        x = ResidualBlock(64, self.norm_type, stride=1)(x, train, frozen_bn)
+        x = ResidualBlock(64, self.norm_type, stride=1, dtype=dt)(x, train, frozen_bn)
+        x = ResidualBlock(64, self.norm_type, stride=1, dtype=dt)(x, train, frozen_bn)
 
-        x = ResidualBlock(96, self.norm_type, stride=2)(x, train, frozen_bn)
-        x = ResidualBlock(96, self.norm_type, stride=1)(x, train, frozen_bn)
+        x = ResidualBlock(96, self.norm_type, stride=2, dtype=dt)(x, train, frozen_bn)
+        x = ResidualBlock(96, self.norm_type, stride=1, dtype=dt)(x, train, frozen_bn)
 
-        x = ResidualBlock(128, self.norm_type, stride=2)(x, train, frozen_bn)
-        x = ResidualBlock(128, self.norm_type, stride=1)(x, train, frozen_bn)
+        x = ResidualBlock(128, self.norm_type, stride=2, dtype=dt)(x, train, frozen_bn)
+        x = ResidualBlock(128, self.norm_type, stride=1, dtype=dt)(x, train, frozen_bn)
 
         return x
 
@@ -55,6 +58,7 @@ class FeatureEncoderS3(nn.Module):
     output_dim: int = 128
     norm_type: str = "instance"
     dropout: float = 0.0
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x, train=False, frozen_bn=False):
@@ -63,8 +67,9 @@ class FeatureEncoderS3(nn.Module):
             n = x[0].shape[0]
             x = jnp.concatenate(x, axis=0)
 
-        x = _Stem(self.norm_type)(x, train, frozen_bn)
-        x = nn.Conv(self.output_dim, (1, 1), kernel_init=kaiming_normal)(x)
+        x = _Stem(self.norm_type, dtype=self.dtype)(x, train, frozen_bn)
+        x = nn.Conv(self.output_dim, (1, 1), kernel_init=kaiming_normal,
+                    dtype=self.dtype)(x)
         if self.dropout > 0:
             x = _drop2d(x, self.dropout, train)
 
@@ -80,13 +85,16 @@ class EncoderOutputNet(nn.Module):
     output_dim: int
     intermediate_dim: int = 128
     norm_type: str = "batch"
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x, train=False, frozen_bn=False):
-        x = nn.Conv(self.intermediate_dim, (3, 3), kernel_init=kaiming_normal)(x)
-        x = Norm2d(self.norm_type, 8)(x, train and not frozen_bn)
+        x = nn.Conv(self.intermediate_dim, (3, 3), kernel_init=kaiming_normal,
+                    dtype=self.dtype)(x)
+        x = Norm2d(self.norm_type, 8, dtype=self.dtype)(x, train and not frozen_bn)
         x = nn.relu(x)
-        x = nn.Conv(self.output_dim, (1, 1), kernel_init=kaiming_normal)(x)
+        x = nn.Conv(self.output_dim, (1, 1), kernel_init=kaiming_normal,
+                    dtype=self.dtype)(x)
         return x
 
 
@@ -102,28 +110,31 @@ class FeatureEncoderPyramid(nn.Module):
     levels: int = 3
     norm_type: str = "instance"
     dropout: float = 0.0
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x, train=False, frozen_bn=False) -> Tuple:
+        dt = self.dtype
         paired = isinstance(x, (tuple, list))
         if paired:
             n = x[0].shape[0]
             x = jnp.concatenate(x, axis=0)
 
-        x = _Stem(self.norm_type)(x, train, frozen_bn)  # 1/8, 128ch
+        x = _Stem(self.norm_type, dtype=dt)(x, train, frozen_bn)  # 1/8, 128ch
 
         stage_channels = (160, 192, 224)
         outputs = []
         for i in range(self.levels):
-            out = EncoderOutputNet(self.output_dim, norm_type=self.norm_type)(x, train, frozen_bn)
+            out = EncoderOutputNet(self.output_dim, norm_type=self.norm_type,
+                                   dtype=dt)(x, train, frozen_bn)
             if self.dropout > 0:
                 out = _drop2d(out, self.dropout, train)
             outputs.append(out)
 
             if i + 1 < self.levels:
                 ch = stage_channels[min(i, len(stage_channels) - 1)]
-                x = ResidualBlock(ch, self.norm_type, stride=2)(x, train, frozen_bn)
-                x = ResidualBlock(ch, self.norm_type, stride=1)(x, train, frozen_bn)
+                x = ResidualBlock(ch, self.norm_type, stride=2, dtype=dt)(x, train, frozen_bn)
+                x = ResidualBlock(ch, self.norm_type, stride=1, dtype=dt)(x, train, frozen_bn)
 
         if paired:
             return (

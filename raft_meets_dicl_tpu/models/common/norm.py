@@ -8,6 +8,8 @@ wrapper passes ``train=False``-equivalent ``use_running_average`` into
 ``Norm2d.__call__`` (see models/model.py ``Model.apply``).
 """
 
+from typing import Any
+
 import flax.linen as nn
 
 NORM_TYPES = ("group", "batch", "instance", "none")
@@ -17,24 +19,30 @@ class Norm2d(nn.Module):
     """Dispatches to group/batch/instance/no normalization over NHWC maps.
 
     ``train`` only affects batch norm (running-stats update vs. use).
+    ``dtype`` is the return/compute dtype; flax norm layers compute the
+    statistics in float32 internally regardless.
     """
 
     ty: str
     num_groups: int = 8
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x, train=False):
         if self.ty == "group":
-            return nn.GroupNorm(num_groups=self.num_groups, epsilon=1e-5)(x)
+            return nn.GroupNorm(
+                num_groups=self.num_groups, epsilon=1e-5, dtype=self.dtype
+            )(x)
         if self.ty == "batch":
             return nn.BatchNorm(
-                use_running_average=not train, momentum=0.9, epsilon=1e-5
+                use_running_average=not train, momentum=0.9, epsilon=1e-5,
+                dtype=self.dtype,
             )(x)
         if self.ty == "instance":
             # per-sample, per-channel over spatial dims; non-affine like torch
             return nn.GroupNorm(
                 num_groups=None, group_size=1, epsilon=1e-5,
-                use_scale=False, use_bias=False,
+                use_scale=False, use_bias=False, dtype=self.dtype,
             )(x)
         if self.ty == "none":
             return x

@@ -18,7 +18,7 @@ Static switches (``iterations``, ``upnet``, ``corr_flow``,
 them recompiles, matching the per-stage argument override model.
 """
 
-from typing import Tuple
+from typing import Any, Tuple
 
 import flax.linen as nn
 import jax
@@ -86,17 +86,21 @@ def make_flow_regression(type, num_levels, radius, **kwargs):
 class BasicMotionEncoder(nn.Module):
     """Combine correlation features and current flow into motion features."""
 
+    dtype: Any = None
+
     @nn.compact
     def __call__(self, flow, corr):
-        cor = nn.relu(nn.Conv(256, (1, 1))(corr))
-        cor = nn.relu(nn.Conv(192, (3, 3))(cor))
+        dt = self.dtype
+        cor = nn.relu(nn.Conv(256, (1, 1), dtype=dt)(corr))
+        cor = nn.relu(nn.Conv(192, (3, 3), dtype=dt)(cor))
 
-        flo = nn.relu(nn.Conv(128, (7, 7))(flow))
-        flo = nn.relu(nn.Conv(64, (3, 3))(flo))
+        flo = nn.relu(nn.Conv(128, (7, 7), dtype=dt)(flow))
+        flo = nn.relu(nn.Conv(64, (3, 3), dtype=dt)(flo))
 
         combined = jnp.concatenate((cor, flo), axis=-1)
-        combined = nn.relu(nn.Conv(128 - 2, (3, 3))(combined))
+        combined = nn.relu(nn.Conv(128 - 2, (3, 3), dtype=dt)(combined))
 
+        flow = flow.astype(combined.dtype)
         return jnp.concatenate((combined, flow), axis=-1)  # 128 channels
 
 
@@ -104,15 +108,18 @@ class SepConvGru(nn.Module):
     """Separable (1x5 then 5x1) convolutional GRU."""
 
     hidden_dim: int = 128
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, h, x):
+        dt = self.dtype
         for ksize in ((1, 5), (5, 1)):
             hx = jnp.concatenate((h, x), axis=-1)
-            z = nn.sigmoid(nn.Conv(self.hidden_dim, ksize)(hx))
-            r = nn.sigmoid(nn.Conv(self.hidden_dim, ksize)(hx))
+            z = nn.sigmoid(nn.Conv(self.hidden_dim, ksize, dtype=dt)(hx))
+            r = nn.sigmoid(nn.Conv(self.hidden_dim, ksize, dtype=dt)(hx))
             q = jnp.tanh(
-                nn.Conv(self.hidden_dim, ksize)(jnp.concatenate((r * h, x), axis=-1))
+                nn.Conv(self.hidden_dim, ksize, dtype=dt)(
+                    jnp.concatenate((r * h, x), axis=-1))
             )
             h = (1.0 - z) * h + z * q
 
@@ -120,28 +127,30 @@ class SepConvGru(nn.Module):
 
 
 class FlowHead(nn.Module):
-    """Hidden state → delta flow."""
+    """Hidden state → delta flow (returned float32)."""
 
     hidden_dim: int = 256
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x):
-        x = nn.relu(nn.Conv(self.hidden_dim, (3, 3))(x))
-        return nn.Conv(2, (3, 3))(x)
+        x = nn.relu(nn.Conv(self.hidden_dim, (3, 3), dtype=self.dtype)(x))
+        return nn.Conv(2, (3, 3), dtype=self.dtype)(x).astype(jnp.float32)
 
 
 class BasicUpdateBlock(nn.Module):
     """One recurrent update: motion encoding + GRU + flow head."""
 
     hidden_dim: int = 128
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, h, x, corr, flow):
-        m = BasicMotionEncoder()(flow, corr)
-        x = jnp.concatenate((x, m), axis=-1)
+        m = BasicMotionEncoder(dtype=self.dtype)(flow, corr)
+        x = jnp.concatenate((x, m.astype(x.dtype)), axis=-1)
 
-        h = SepConvGru(self.hidden_dim)(h, x)
-        d = FlowHead(256)(h)
+        h = SepConvGru(self.hidden_dim, dtype=self.dtype)(h, x)
+        d = FlowHead(256, dtype=self.dtype)(h)
 
         return h, d
 
@@ -150,15 +159,16 @@ class Up8Network(nn.Module):
     """Convex 8x upsampling: per-pixel softmax over 3x3 coarse neighbors."""
 
     temperature: float = 4.0  # 4.0 = 1.0/0.25 in original RAFT
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, hidden, flow):
         b, h, w, c = flow.shape
 
-        mask = nn.Conv(256, (3, 3))(hidden)
+        mask = nn.Conv(256, (3, 3), dtype=self.dtype)(hidden)
         mask = nn.relu(mask)
-        mask = nn.Conv(8 * 8 * 9, (1, 1))(mask)
-        mask = mask.reshape(b, h, w, 9, 8, 8)
+        mask = nn.Conv(8 * 8 * 9, (1, 1), dtype=self.dtype)(mask)
+        mask = mask.reshape(b, h, w, 9, 8, 8).astype(jnp.float32)
         mask = jax.nn.softmax(mask / self.temperature, axis=3)
 
         win = unfold3x3(8.0 * flow)  # (B, h, w, 9, 2)
@@ -185,6 +195,7 @@ class _RaftStep(nn.Module):
     corr_reg_type: str
     corr_reg_args: dict
     full_shape: Tuple[int, int]
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, carry, pyramid, x, coords0):
@@ -208,13 +219,14 @@ class _RaftStep(nn.Module):
         if self.corr_grad_stop:
             corr = jax.lax.stop_gradient(corr)
 
-        h, d = BasicUpdateBlock(self.recurrent_channels)(h, x, corr, flow)
+        h, d = BasicUpdateBlock(self.recurrent_channels, dtype=self.dtype)(
+            h, x, corr, flow)
 
         coords1 = coords1 + d
         flow = coords1 - coords0
 
         # same always-call rule for the upsampling network
-        flow_up_net = Up8Network()(h, flow)
+        flow_up_net = Up8Network(dtype=self.dtype)(h, flow)
         if self.upnet:
             flow_up = flow_up_net
         else:
@@ -249,22 +261,33 @@ class RaftModule(nn.Module):
         cdim = self.context_channels
         reg_args = self.corr_reg_args or {}
 
+        # bf16 compute policy (the reference's autocast regions,
+        # src/models/impls/raft.py:377-415): encoders, correlation volume,
+        # and update block run in bf16; params, coords/flow arithmetic,
+        # softmaxes, and the loss stay float32. MXU contractions accumulate
+        # in float32 via preferred_element_type.
+        dt = jnp.bfloat16 if self.mixed_precision else None
+
         fnet = common.encoders.make_encoder_s3(
             self.encoder_type, output_dim=self.corr_channels,
-            norm_type=self.encoder_norm, dropout=self.dropout,
+            norm_type=self.encoder_norm, dropout=self.dropout, dtype=dt,
         )
         cnet = common.encoders.make_encoder_s3(
             self.context_type, output_dim=hdim + cdim,
-            norm_type=self.context_norm, dropout=self.dropout,
+            norm_type=self.context_norm, dropout=self.dropout, dtype=dt,
         )
 
         fmap1, fmap2 = fnet((img1, img2), train, frozen_bn)
-        fmap1 = fmap1.astype(jnp.float32)
-        fmap2 = fmap2.astype(jnp.float32)
+        if dt is None:
+            fmap1 = fmap1.astype(jnp.float32)
+            fmap2 = fmap2.astype(jnp.float32)
 
-        pyramid = correlation_pyramid(
-            all_pairs_correlation(fmap1, fmap2), self.corr_levels
-        )
+        corr_full = all_pairs_correlation(fmap1, fmap2)
+        if dt is not None:
+            # keep the O(H²W²) volume in bf16: halves HBM footprint and
+            # lookup read traffic; the lookup einsums accumulate in f32
+            corr_full = corr_full.astype(dt)
+        pyramid = correlation_pyramid(corr_full, self.corr_levels)
 
         ctx = cnet(img1, train, frozen_bn)
         h = jnp.tanh(ctx[..., :hdim])
@@ -296,6 +319,7 @@ class RaftModule(nn.Module):
             corr_reg_type=self.corr_reg_type,
             corr_reg_args=reg_args,
             full_shape=(img1.shape[1], img1.shape[2]),
+            dtype=dt,
         )
 
         (h, coords1), (flows_up, corr_flows) = step(

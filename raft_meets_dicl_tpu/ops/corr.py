@@ -71,17 +71,40 @@ def window_delta(radius, dtype=jnp.float32):
 _window_delta = window_delta
 
 
+def _interp_matrix(positions, size):
+    """Bilinear interpolation matrix: hat weights over an axis.
+
+    positions: (..., K) float sample positions along an axis of length
+    ``size``. Returns (..., K, size) with ``w[..., k, i] =
+    max(0, 1 - |positions[..., k] - i|)`` — exactly bilinear interpolation
+    with zero padding outside (out-of-range corners simply have no column),
+    matching ``F.grid_sample(align_corners=True, padding_mode='zeros')``.
+    """
+    idx = jnp.arange(size, dtype=positions.dtype)
+    return jnp.maximum(0.0, 1.0 - jnp.abs(positions[..., None] - idx))
+
+
 def _lookup_level(corr, x, y):
     """Bilinearly sample a (B, H1, W1, H2, W2) volume at per-position windows.
 
-    x, y: (B, H1, W1, K, K) pixel coordinates into the (H2, W2) axes.
-    Returns (B, H1, W1, K, K). Zero padding outside, align_corners=True —
-    delegates to the shared grid-sample-parity gather with (B, H1, W1) as
-    batch dims.
-    """
-    from .sample import sample_bilinear
+    x, y: (B, H1, W1, K) pixel coordinates into the W2/H2 axes (the K×K
+    window factorizes into per-axis offsets). Returns (B, H1, W1, K, K)
+    with axes ordered (x-window, y-window).
 
-    return sample_bilinear(corr[..., None], x, y)[..., 0]
+    TPU-first design: instead of gathering scalars (XLA gather costs ~16ns
+    per index on TPU — profiled as 95% of the forward pass), the bilinear
+    window lookup contracts the volume with two tiny structured
+    interpolation matrices. Both contractions ride the MXU and their VJPs
+    are transposed einsums (no scatter in the backward pass).
+    """
+    h2, w2 = corr.shape[-2:]
+    wy = _interp_matrix(y, h2)  # (B, H1, W1, K, H2)
+    wx = _interp_matrix(x, w2)  # (B, H1, W1, K, W2)
+
+    t = jnp.einsum("bijkh,bijhw->bijkw", wy, corr,
+                   preferred_element_type=jnp.float32)
+    return jnp.einsum("bijaw,bijkw->bijak", wx, t,
+                      preferred_element_type=jnp.float32)
 
 
 def lookup_pyramid(pyramid, coords, radius, mask_costs=()):
@@ -93,14 +116,14 @@ def lookup_pyramid(pyramid, coords, radius, mask_costs=()):
     downsampling octave), matching the reference's convention (raft.py:86).
     """
     k = 2 * radius + 1
-    delta = _window_delta(radius, coords.dtype)
+    d = jnp.linspace(-radius, radius, k, dtype=coords.dtype)
 
     out = []
     for i, corr in enumerate(pyramid):
-        centers = coords[:, :, :, None, None, :] / (2**i) + delta
-        x = centers[..., 0].reshape(*coords.shape[:3], k, k)
-        y = centers[..., 1].reshape(*coords.shape[:3], k, k)
-        level = _lookup_level(corr, x, y)
+        centers = coords / (2**i)
+        x = centers[..., 0:1] + d  # (B, H, W, K) window positions along W2
+        y = centers[..., 1:2] + d  # (B, H, W, K) window positions along H2
+        level = _lookup_level(corr, x, y)  # (..., K_dx, K_dy)
         level = level.reshape(*coords.shape[:3], k * k)
         if i + 3 in mask_costs:
             level = jnp.zeros_like(level)

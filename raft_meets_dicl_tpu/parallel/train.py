@@ -41,7 +41,8 @@ class TrainState(struct.PyTreeNode):
 
 
 def make_train_step(model, loss_fn, tx, mesh=None, loss_args=None,
-                    model_args=None, donate=True, external_lr=False):
+                    model_args=None, donate=True, external_lr=False,
+                    with_grads=False):
     """Build the jitted training step.
 
     Static per-stage configuration (``model_args``, ``loss_args``) is baked
@@ -55,6 +56,11 @@ def make_train_step(model, loss_fn, tx, mesh=None, loss_args=None,
 
     With ``mesh``, input/output shardings are annotated: state replicated,
     batch split on the leading axis over ``data``.
+
+    ``with_grads`` adds the raw gradient pytree to ``aux`` for inspection
+    (gradient-statistics metrics). Off by default: returning grads keeps a
+    second params-sized buffer alive past the optimizer update, defeating
+    donation.
     """
     loss_args = dict(loss_args or {})
     model_args = dict(model_args or {})
@@ -87,45 +93,36 @@ def make_train_step(model, loss_fn, tx, mesh=None, loss_args=None,
         aux = {
             "loss": loss,
             "final": final,
-            "grads": grads,
             "finite": jnp.all(jnp.isfinite(final)),
         }
+        if with_grads:
+            aux["grads"] = grads
         return new_state, aux
 
-    if not external_lr:
+    if external_lr:
+        public = step
+        n_lead = 2  # (state, lr, ...)
+    else:
         # bind a dummy lr so the public signature stays (state, batch...)
-        inner = step
+        def public(state, img1, img2, flow, valid):
+            return step(state, 0.0, img1, img2, flow, valid)
 
-        def step_no_lr(state, img1, img2, flow, valid):
-            return inner(state, 0.0, img1, img2, flow, valid)
-
-        if mesh is None:
-            return jax.jit(step_no_lr, donate_argnums=(0,) if donate else ())
-
-        repl = NamedSharding(mesh, P())
-        data = NamedSharding(mesh, P("data"))
-        return jax.jit(
-            step_no_lr,
-            in_shardings=(repl, data, data, data, data),
-            out_shardings=(
-                repl,
-                {"loss": repl, "final": data, "grads": repl, "finite": repl},
-            ),
-            donate_argnums=(0,) if donate else (),
-        )
+        n_lead = 1
 
     if mesh is None:
-        return jax.jit(step, donate_argnums=(0,) if donate else ())
+        return jax.jit(public, donate_argnums=(0,) if donate else ())
 
     repl = NamedSharding(mesh, P())
     data = NamedSharding(mesh, P("data"))
+    aux_shardings = {"loss": repl, "final": data, "finite": repl}
+    if with_grads:
+        aux_shardings["grads"] = repl
+
+    in_shardings = (repl,) + (None,) * (n_lead - 1) + (data,) * 4
     return jax.jit(
-        step,
-        in_shardings=(repl, None, data, data, data, data),
-        out_shardings=(
-            repl,
-            {"loss": repl, "final": data, "grads": repl, "finite": repl},
-        ),
+        public,
+        in_shardings=in_shardings,
+        out_shardings=(repl, aux_shardings),
         donate_argnums=(0,) if donate else (),
     )
 

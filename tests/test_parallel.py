@@ -58,14 +58,14 @@ def test_sharded_train_step_matches_single_device():
 
     # single-device reference
     state1 = parallel.TrainState.create(variables, tx)
-    step1 = parallel.make_train_step(model, loss, tx, donate=False)
+    step1 = parallel.make_train_step(model, loss, tx, donate=False, with_grads=True)
     state1, aux1 = step1(state1, img1, img2, flow, valid)
 
     # 8-device mesh
     mesh = parallel.data_mesh(8)
     state8 = parallel.TrainState.create(variables, tx)
     state8 = parallel.replicate(state8, mesh)
-    step8 = parallel.make_train_step(model, loss, tx, mesh=mesh, donate=False)
+    step8 = parallel.make_train_step(model, loss, tx, mesh=mesh, donate=False, with_grads=True)
     batch = parallel.shard_batch((img1, img2, flow, valid), mesh)
     state8, aux8 = step8(state8, *batch)
 
