@@ -44,9 +44,11 @@ from . import (  # noqa: E402
     parallel,
     strategy,
     utils,
+    visual,
 )
+from . import inspect  # noqa: E402  (module name mirrors the reference)
 
 __all__ = [
-    "data", "evaluation", "metrics", "models", "ops", "parallel",
-    "strategy", "utils",
+    "data", "evaluation", "inspect", "metrics", "models", "ops", "parallel",
+    "strategy", "utils", "visual",
 ]

@@ -37,16 +37,35 @@ class EvalSample:
 
 # jitted eval fns memoized per (model, args) so repeated evaluate() calls —
 # e.g. a validation pass every N training steps — hit the jit cache instead
-# of re-tracing the full forward pass each time
+# of re-tracing the full forward pass each time. Bounded FIFO (evicting an
+# entry drops its closure + compiled executables) so long-lived processes
+# sweeping many models don't pin every one forever.
 _EVAL_FN_CACHE = {}
+_EVAL_FN_CACHE_MAX = 8
+
+
+def _cache_key(model, model_args):
+    """Cache key, or None when any arg can't be keyed exactly.
+
+    Array-valued args (e.g. ``flow_init``) are traced into the jit as
+    constants, and their reprs truncate — two different arrays could share a
+    key. Such calls bypass the cache instead.
+    """
+    parts = []
+    for k, v in sorted(model_args.items()):
+        if hasattr(v, "shape") or (
+            isinstance(v, (list, tuple)) and any(hasattr(x, "shape") for x in v)
+        ):
+            return None
+        parts.append((k, repr(v)))
+    return (id(model), tuple(parts))
 
 
 def make_eval_fn(model, model_args=None):
     """Jitted ``(variables, img1, img2) -> (raw_output, final_flow)``."""
     model_args = dict(model_args or {})
-    # repr-key the args: values may be unhashable (lists, e.g. mask_costs)
-    key = (id(model), tuple(sorted((k, repr(v)) for k, v in model_args.items())))
-    if key in _EVAL_FN_CACHE:
+    key = _cache_key(model, model_args)
+    if key is not None and key in _EVAL_FN_CACHE:
         return _EVAL_FN_CACHE[key]
 
     adapter = model.get_adapter()
@@ -57,7 +76,10 @@ def make_eval_fn(model, model_args=None):
         result = adapter.wrap_result(out, img1.shape[1:3])
         return out, result.final()
 
-    _EVAL_FN_CACHE[key] = step
+    if key is not None:
+        while len(_EVAL_FN_CACHE) >= _EVAL_FN_CACHE_MAX:
+            _EVAL_FN_CACHE.pop(next(iter(_EVAL_FN_CACHE)))
+        _EVAL_FN_CACHE[key] = step
     return step
 
 

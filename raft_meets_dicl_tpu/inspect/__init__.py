@@ -1,0 +1,7 @@
+from . import config, hooks, summary, writer
+from .config import load
+from .summary import InspectorSpec, SummaryInspector
+from .writer import SummaryWriter
+
+__all__ = ["config", "hooks", "summary", "writer", "load", "InspectorSpec",
+           "SummaryInspector", "SummaryWriter"]

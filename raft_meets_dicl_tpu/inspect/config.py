@@ -1,0 +1,10 @@
+"""Inspector config loading (reference src/inspect/config.py:7)."""
+
+from .. import utils
+from . import summary
+
+
+def load(cfg):
+    if not isinstance(cfg, dict):
+        return summary.InspectorSpec.from_config(utils.config.load(cfg))
+    return summary.InspectorSpec.from_config(cfg)

@@ -1,0 +1,5 @@
+from . import activation, anomaly, common
+from .common import Handle, Hook, flatten_intermediates
+
+__all__ = ["activation", "anomaly", "common", "Handle", "Hook",
+           "flatten_intermediates"]

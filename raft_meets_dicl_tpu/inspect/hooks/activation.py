@@ -1,0 +1,64 @@
+"""Per-module activation statistics (mean/var) to TensorBoard.
+
+Capability parity with the reference's forward-hook version
+(src/inspect/hooks/activation.py:6-66); here the activations arrive as a
+flax ``capture_intermediates`` tree from an auxiliary forward pass run at
+``frequency`` (the torch version pays the stats on every forward; the jit
+version pays a full extra forward but only when sampled).
+"""
+
+from typing import List
+
+import numpy as np
+
+from .common import Hook, flatten_intermediates
+
+
+class ActivationStats(Hook):
+    type = "activation-stats"
+    needs_intermediates = True
+
+    @classmethod
+    def from_config(cls, cfg):
+        cls._typecheck(cfg)
+        return cls(
+            cfg["modules"],
+            cfg.get("prefix", "Train:S{n_stage}:{id_stage}/ActivationStats/"),
+            int(cfg.get("frequency", 100)),
+        )
+
+    def __init__(self, modules: List[str],
+                 prefix: str = "Train:S{n_stage}:{id_stage}/ActivationStats/",
+                 frequency: int = 100):
+        super().__init__("training")
+        self.modules = list(modules)
+        self.prefix = prefix
+        self.frequency = frequency
+        self.writer = None
+
+    def get_config(self):
+        return {
+            "type": self.type,
+            "prefix": self.prefix,
+            "modules": self.modules,
+            "frequency": self.frequency,
+        }
+
+    def register(self, ctx, writer):
+        self.writer = writer
+        return super().register(ctx, writer)
+
+    def on_intermediates(self, log, ctx, intermediates):
+        named = flatten_intermediates(intermediates)
+
+        for target in self.modules:
+            matches = [(n, a) for n, a in named
+                       if n == target or n.startswith(target + ".")]
+            for i, (_, act) in enumerate(matches):
+                act = np.asarray(act)
+                self.writer.add_scalar(
+                    f"{self.prefix}{target}.{i}/mean", float(act.mean()), ctx.step
+                )
+                self.writer.add_scalar(
+                    f"{self.prefix}{target}.{i}/var", float(act.var()), ctx.step
+                )

@@ -1,0 +1,149 @@
+"""Anomaly detection: non-finite / absurdly large activations or gradients.
+
+Capability parity with the reference detectors
+(src/inspect/hooks/anomaly.py:16-246): on trigger, a warning names the
+offending tensor and a rolling set of at most ``max-checkpoints`` debug
+checkpoints is dumped. Activation checks read the captured-intermediates
+tree of the auxiliary forward pass; gradient checks read the train step's
+gradient pytree (compiled in when this hook is configured).
+"""
+
+from datetime import datetime
+
+import numpy as np
+
+from ...metrics.functional import tree_named_leaves
+from .common import Hook, flatten_intermediates
+
+_DEFAULT_CHKPT_ACTIVATION = "anomaly_in_activation-b{n_step}.ckpt"
+_DEFAULT_CHKPT_GRADIENT = "anomaly_in_gradient-b{n_step}.ckpt"
+
+
+class _AnomalyDetector(Hook):
+    def __init__(self, large, checkpoint, checkpoint_fmt, checkpoint_max):
+        super().__init__("training")
+        self.large = float(large)
+        self.checkpoint = bool(checkpoint)
+        self.checkpoint_fmt = checkpoint_fmt
+        self.checkpoint_max = int(checkpoint_max)
+        self.writer = None
+        self._chkpts = []
+        self._dumped_step = None
+
+    def get_config(self):
+        return {
+            "type": self.type,
+            "large": self.large,
+            "checkpoint": self.checkpoint,
+            "checkpoint-fmt": self.checkpoint_fmt,
+            "checkpoint-max": self.checkpoint_max,
+        }
+
+    def register(self, ctx, writer):
+        self.writer = writer
+        return super().register(ctx, writer)
+
+    def _check(self, log, ctx, kind, named):
+        for name, arr in named:
+            arr = np.asarray(arr)
+            if not np.issubdtype(arr.dtype, np.floating):
+                continue
+
+            reason = None
+            if not np.all(np.isfinite(arr)):
+                reason = "non-finite"
+            elif np.abs(arr).max() > self.large:
+                reason = "large"
+
+            if reason is not None:
+                log.warn(
+                    f"{kind} anomaly detected: {reason} value detected in "
+                    f"'{name}', shape {arr.shape}"
+                )
+                self._dump_chkpt(log, ctx)
+
+    def _dump_chkpt(self, log, ctx):
+        # at most one dump per training step, rolling retention
+        if not self.checkpoint or self._dumped_step == ctx.step:
+            return
+
+        from ...strategy import checkpoint
+
+        path = ctx.path / self.writer.fmt(self.checkpoint_fmt)
+        log.info(f"saving checkpoint to {path}")
+
+        chkpt = checkpoint.Checkpoint(
+            model=ctx.model_id,
+            iteration=checkpoint.Iteration(
+                ctx.current_stage.index, ctx.current_epoch, ctx.step
+            ),
+            metrics=None,
+            state=checkpoint.State(
+                model=ctx.train_variables(),
+                optimizer=ctx.opt_state(),
+                scaler=dict(ctx.scaler or {}),
+                lr_sched_inst=[s.state_dict() for s in ctx.lr_sched_inst or []],
+                lr_sched_epoch=[s.state_dict() for s in ctx.lr_sched_epoch or []],
+            ),
+            metadata={
+                "timestamp": datetime.now().isoformat(),
+                "source": "training",
+            },
+        )
+        chkpt.save(path)
+
+        self._chkpts.append(path)
+        self._dumped_step = ctx.step
+
+        while len(self._chkpts) > self.checkpoint_max:
+            self._chkpts.pop(0).unlink(missing_ok=True)
+
+
+class ActivationAnomalyDetector(_AnomalyDetector):
+    type = "anomalydetect-activation"
+    needs_intermediates = True
+
+    @classmethod
+    def from_config(cls, cfg):
+        cls._typecheck(cfg)
+        return cls(
+            cfg.get("large", 1.0e10),
+            cfg.get("save-checkpoint", False),
+            cfg.get("checkpoint-fmt", _DEFAULT_CHKPT_ACTIVATION),
+            cfg.get("max-checkpoints", 10),
+            int(cfg.get("frequency", 1)),
+        )
+
+    def __init__(self, large=1.0e10, checkpoint=False,
+                 checkpoint_fmt=_DEFAULT_CHKPT_ACTIVATION, checkpoint_max=10,
+                 frequency=1):
+        super().__init__(large, checkpoint, checkpoint_fmt, checkpoint_max)
+        self.frequency = frequency
+
+    def get_config(self):
+        return super().get_config() | {"frequency": self.frequency}
+
+    def on_intermediates(self, log, ctx, intermediates):
+        self._check(log, ctx, "activation", flatten_intermediates(intermediates))
+
+
+class GradientAnomalyDetector(_AnomalyDetector):
+    type = "anomalydetect-gradient"
+    needs_grads = True
+
+    @classmethod
+    def from_config(cls, cfg):
+        cls._typecheck(cfg)
+        return cls(
+            cfg.get("large", 1.0e10),
+            cfg.get("save-checkpoint", False),
+            cfg.get("checkpoint-fmt", _DEFAULT_CHKPT_GRADIENT),
+            cfg.get("max-checkpoints", 10),
+        )
+
+    def __init__(self, large=1.0e10, checkpoint=False,
+                 checkpoint_fmt=_DEFAULT_CHKPT_GRADIENT, checkpoint_max=10):
+        super().__init__(large, checkpoint, checkpoint_fmt, checkpoint_max)
+
+    def on_grads(self, log, ctx, grads):
+        self._check(log, ctx, "gradient", tree_named_leaves(grads))

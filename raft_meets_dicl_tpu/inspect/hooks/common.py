@@ -1,0 +1,96 @@
+"""Hook protocol: config-constructible observers of the training process.
+
+TPU-native redesign of the reference's torch-module hooks
+(src/inspect/hooks/common.py:20-53). A pure jitted program has no place to
+attach callbacks at runtime, so a hook instead *declares* what it needs and
+the inspector provides it:
+
+- ``needs_intermediates``: the inspector runs an auxiliary forward pass with
+  flax ``capture_intermediates`` at the hook's frequency and hands the hook
+  the captured activations tree (``on_intermediates``),
+- ``needs_grads``: the train step is compiled with gradients in its aux
+  output and the hook receives the pytree every step (``on_grads``).
+
+``when`` ('training' | 'validation' | 'all') gates which phases a hook is
+active in, matching the reference's register/remove swapping
+(src/inspect/summary.py:530-562). ``register``/``Handle.remove`` keep the
+same activation lifecycle shape.
+"""
+
+
+class Handle:
+    def __init__(self, hook):
+        self.hook = hook
+
+    def remove(self):
+        self.hook.active = False
+
+
+class Hook:
+    type = None
+    needs_intermediates = False
+    needs_grads = False
+
+    @classmethod
+    def _typecheck(cls, cfg):
+        if cfg["type"] != cls.type:
+            raise ValueError(
+                f"invalid hook type '{cfg['type']}', expected '{cls.type}'"
+            )
+
+    @classmethod
+    def from_config(cls, cfg):
+        from . import activation, anomaly
+
+        types = [
+            activation.ActivationStats,
+            anomaly.ActivationAnomalyDetector,
+            anomaly.GradientAnomalyDetector,
+        ]
+        types = {t.type: t for t in types}
+
+        return types[cfg["type"]].from_config(cfg)
+
+    def __init__(self, when):
+        if when not in ("training", "validation", "all"):
+            raise ValueError(f"invalid hook attribute 'when': '{when}'")
+        self.when = when
+        self.active = False
+
+    def get_config(self):
+        raise NotImplementedError
+
+    def register(self, ctx, writer) -> Handle:
+        self.active = True
+        return Handle(self)
+
+    def on_intermediates(self, log, ctx, intermediates):
+        """Called with the captured-activations tree when active."""
+
+    def on_grads(self, log, ctx, grads):
+        """Called with the gradient pytree when active."""
+
+
+def flatten_intermediates(tree, prefix=""):
+    """Flatten a flax intermediates collection into [(dotted-name, array)].
+
+    Capture entries appear as ``{module: {...: {'__call__': (value,)}}}``;
+    tuple wrappers are unwrapped, tuple/list outputs enumerated.
+    """
+    out = []
+
+    def walk(node, name):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(v, f"{name}.{k}" if name and k != "__call__" else name or k)
+        elif isinstance(node, (tuple, list)):
+            if len(node) == 1:
+                walk(node[0], name)
+            else:
+                for i, v in enumerate(node):
+                    walk(v, f"{name}.{i}")
+        elif node is not None and hasattr(node, "shape"):
+            out.append((name, node))
+
+    walk(tree, prefix)
+    return out

@@ -1,0 +1,105 @@
+"""TensorBoard event writing with ``{key}``-templated tags.
+
+The reference subclasses torch's SummaryWriter (src/inspect/summary.py:32-45);
+here the writer sits directly on the ``tensorboard`` package's event-file
+writer — scalars and PNG-encoded images, no torch in the training path.
+"""
+
+import time
+
+import cv2
+import numpy as np
+
+
+class KvFormatter:
+    """format_map with late-bound arguments (src/inspect/summary.py:21-29)."""
+
+    def __init__(self, fmtargs={}):
+        self.fmtargs = dict(fmtargs)
+
+    def set_fmtargs(self, fmtargs):
+        self.fmtargs = dict(fmtargs)
+
+    def __call__(self, string):
+        return string.format_map(self.fmtargs)
+
+
+class SummaryWriter:
+    """Writes TB event files; tags are formatted through a KvFormatter.
+
+    Keys may contain ``{n_stage}``/``{id_stage}``/``{n_epoch}``/``{n_step}``/
+    ``{id_val}``/``{img_idx}`` placeholders bound via ``set_fmtargs`` before
+    each write, exactly like the reference writer.
+    """
+
+    def __init__(self, log_dir):
+        from tensorboard.summary.writer.event_file_writer import EventFileWriter
+
+        self.log_dir = str(log_dir)
+        self._writer = EventFileWriter(self.log_dir)
+        self.fmt = KvFormatter()
+
+    def set_fmtargs(self, fmtargs):
+        self.fmt.set_fmtargs(fmtargs)
+
+    def _add_event(self, summary, step):
+        from tensorboard.compat.proto import event_pb2
+
+        event = event_pb2.Event(summary=summary)
+        event.wall_time = time.time()
+        if step is not None:
+            event.step = int(step)
+        self._writer.add_event(event)
+
+    def add_scalar(self, key, value, step=None):
+        from tensorboard.compat.proto import summary_pb2
+
+        summary = summary_pb2.Summary(
+            value=[summary_pb2.Summary.Value(
+                tag=self.fmt(key), simple_value=float(value),
+            )]
+        )
+        self._add_event(summary, step)
+
+    def add_image(self, key, img, step=None, dataformats="HWC"):
+        """``img``: float [0, 1] or uint8; HWC with 1/3/4 channels (or CHW
+        when ``dataformats='CHW'``)."""
+        from tensorboard.compat.proto import summary_pb2
+
+        img = np.asarray(img)
+        if dataformats == "CHW":
+            img = np.transpose(img, (1, 2, 0))
+        elif dataformats != "HWC":
+            raise ValueError(f"unsupported dataformats '{dataformats}'")
+
+        if img.ndim == 2:
+            img = img[..., None]
+        if img.dtype != np.uint8:
+            img = (np.clip(img, 0.0, 1.0) * 255.0).astype(np.uint8)
+
+        channels = img.shape[-1]
+        if channels == 3:
+            encoded = cv2.imencode(".png", img[..., ::-1])[1].tobytes()
+        elif channels == 4:
+            bgra = img[..., [2, 1, 0, 3]]
+            encoded = cv2.imencode(".png", bgra)[1].tobytes()
+        else:
+            encoded = cv2.imencode(".png", img)[1].tobytes()
+
+        summary = summary_pb2.Summary(
+            value=[summary_pb2.Summary.Value(
+                tag=self.fmt(key),
+                image=summary_pb2.Summary.Image(
+                    height=img.shape[0], width=img.shape[1],
+                    colorspace=channels,
+                    encoded_image_string=encoded,
+                ),
+            )]
+        )
+        self._add_event(summary, step)
+
+    def flush(self):
+        self._writer.flush()
+
+    def close(self):
+        self._writer.close()

@@ -55,6 +55,15 @@ def correlation_pyramid(corr, num_levels=4):
     return pyramid
 
 
+def window_offsets(radius, dtype=jnp.float32):
+    """(2r+1,) per-axis window offsets: -r, ..., 0, ..., r.
+
+    The single source of truth for window sampling positions — both the 2-D
+    ``window_delta`` grid and the factorized per-axis lookups derive from it.
+    """
+    return jnp.linspace(-radius, radius, 2 * radius + 1, dtype=dtype)
+
+
 def window_delta(radius, dtype=jnp.float32):
     """(K, K, 2) window offsets; axis 0 varies x, axis 1 varies y.
 
@@ -63,7 +72,7 @@ def window_delta(radius, dtype=jnp.float32):
     channel layout of every windowed lookup/readout in the framework —
     import it rather than re-deriving it.
     """
-    d = jnp.linspace(-radius, radius, 2 * radius + 1, dtype=dtype)
+    d = window_offsets(radius, dtype)
     dx, dy = jnp.meshgrid(d, d, indexing="ij")
     return jnp.stack((dx, dy), axis=-1)
 
@@ -116,7 +125,7 @@ def lookup_pyramid(pyramid, coords, radius, mask_costs=()):
     downsampling octave), matching the reference's convention (raft.py:86).
     """
     k = 2 * radius + 1
-    d = jnp.linspace(-radius, radius, k, dtype=coords.dtype)
+    d = window_offsets(radius, coords.dtype)
 
     out = []
     for i, corr in enumerate(pyramid):

@@ -66,6 +66,13 @@ class TrainingContext:
         self.step = 0
         self.step_limit = step_limit
 
+        # executed micro-batches within the current stage; drives the
+        # accumulation boundary in lockstep with optax.MultiSteps (which
+        # counts tx.update calls) so an invalid-batch skip costs one
+        # micro-batch instead of desyncing host and device counters
+        self._accum = 0
+        self._in_step = False
+
         # per-run / per-stage state
         self.variables = None       # model variables when no stage is active
         self.state: Optional[TrainState] = None
@@ -250,11 +257,18 @@ class TrainingContext:
         # baked into the compiled program
         self.model_adapter.on_stage(stage, **stage.model_on_stage_args)
 
+        # gradients enter the step's aux output only if observability asks
+        # (gradient metrics/hooks) — they cost a params-sized live buffer
+        with_grads = bool(getattr(self.inspector, "wants_gradients", False))
+
         self.step_fn = make_train_step(
             self.model, self.loss, self.tx, mesh=self.mesh,
             loss_args=stage.loss_args, model_args=stage.model_args,
-            external_lr=True, donate=True,
+            external_lr=True, donate=True, with_grads=with_grads,
         )
+
+        self._accum = 0
+        self._in_step = False
 
         self.inspector.on_stage_start(log, self, stage)
 
@@ -308,10 +322,14 @@ class TrainingContext:
     def run_instance(self, log, stage, epoch, i, img1, img2, flow, valid, meta):
         accumulate = stage.gradient.accumulate
 
-        if i % accumulate == 0:
+        if not self._in_step:
             self.inspector.on_step_start(log, self, stage, epoch, i)
+            self._in_step = True
 
-        # check for degeneracies in samples and warn/skip
+        # check for degeneracies in samples and warn/skip — the boundary is
+        # driven by executed micro-batches, so a skip shifts the step by one
+        # batch (like the reference's zero-grad-on-boundary) instead of
+        # desyncing against the in-step MultiSteps counter
         if not all(m.valid for m in meta):
             log.warn("skipping batch due to invalid data")
             return
@@ -345,7 +363,8 @@ class TrainingContext:
         self.inspector.on_batch(log, self, stage, epoch, i, img1, img2, flow,
                                 valid, meta, result, loss)
 
-        if (i + 1) % accumulate == 0:
+        self._accum += 1
+        if self._accum % accumulate == 0:
             # the optimizer update itself happened inside the jitted step
             # (optax.MultiSteps applies on every accumulate-th call)
             for s in self.lr_sched_inst:
@@ -353,6 +372,7 @@ class TrainingContext:
 
             self.inspector.on_step_end(log, self, stage, epoch, i)
             self.step += 1
+            self._in_step = False
 
     def _dump_failed(self, log, stage, epoch):
         log.error("detected non-finite values in final flow field")

@@ -1,0 +1,19 @@
+"""Backwards-warp preview: resample img2 by the estimated flow.
+
+Host-facing wrapper over the jax warp op (capability parity with reference
+src/visual/warp.py:6-14, which wraps the torch warp).
+"""
+
+import numpy as np
+
+from ..ops import warp as _warp
+
+
+def warp_backwards(img2, flow, eps=1e-5):
+    """Warp a single HWC image by an HW2 flow field; returns HWC numpy."""
+    est, _mask = _warp.warp_backwards(
+        np.asarray(img2, np.float32)[None],
+        np.asarray(flow, np.float32)[None],
+        eps=eps,
+    )
+    return np.asarray(est[0])

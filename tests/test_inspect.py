@@ -1,0 +1,262 @@
+"""Observability-layer tests: TB writer, SummaryInspector, validation-driven
+checkpoints, hooks, and the grad-accum skip realignment."""
+
+import numpy as np
+
+import raft_meets_dicl_tpu.inspect as inspect_
+import raft_meets_dicl_tpu.models as models
+import raft_meets_dicl_tpu.strategy as strategy
+from raft_meets_dicl_tpu.data.collection import Collection
+from raft_meets_dicl_tpu.data.dataset import Metadata, SampleArgs, SampleId
+from raft_meets_dicl_tpu.utils.logging import Logger
+
+from test_strategy import TINY_MODEL, FlowSource, _make_stage
+
+
+def _read_events(tb_dir):
+    """All (tag, step, value|'img') tuples from every event file in a dir."""
+    from tensorboard.backend.event_processing.event_file_loader import (
+        EventFileLoader,
+    )
+
+    out = []
+    for f in sorted(tb_dir.glob("events.out.tfevents.*")):
+        for event in EventFileLoader(str(f)).Load():
+            for value in event.summary.value:
+                # the event writer migrates both scalars and images to the
+                # generic tensor representation; the plugin name tells them
+                # apart
+                plugin = value.metadata.plugin_data.plugin_name
+                if value.HasField("simple_value"):
+                    out.append((value.tag, event.step, value.simple_value))
+                elif plugin == "scalars" and value.HasField("tensor"):
+                    out.append((value.tag, event.step,
+                                float(value.tensor.float_val[0])))
+                elif plugin == "images" or value.HasField("image"):
+                    out.append((value.tag, event.step, "img"))
+    return out
+
+
+def test_summary_writer_scalars_and_images(tmp_path):
+    w = inspect_.SummaryWriter(tmp_path / "tb")
+    w.set_fmtargs({"n_stage": 0, "id_stage": "test.s0"})
+    w.add_scalar("Train:S{n_stage}:{id_stage}/Loss", 0.5, 3)
+    w.add_image("Train:S{n_stage}:{id_stage}/img1",
+                np.random.rand(8, 12, 3).astype(np.float32), 3)
+    w.add_image("rgba", np.random.rand(8, 12, 4), 4)
+    w.close()
+
+    events = _read_events(tmp_path / "tb")
+    assert ("Train:S0:test.s0/Loss", 3, 0.5) in events
+    assert ("Train:S0:test.s0/img1", 3, "img") in events
+    assert ("rgba", 4, "img") in events
+
+
+INSPECT_CFG = {
+    "metrics": [{
+        "prefix": "Train:S{n_stage}:{id_stage}/",
+        "frequency": 1,
+        "metrics": [
+            {"type": "epe"},
+            {"type": "loss"},
+            {"type": "learning-rate"},
+            {"type": "grad-norm"},
+        ],
+    }],
+    "images": {"frequency": 1, "prefix": "Train:S{n_stage}:{id_stage}/"},
+    "checkpoints": {
+        "path": "checkpoints",
+        "name": "{id_model}-s{n_stage}_e{n_epoch}_b{n_steps}"
+                "-epe{m_EndPointError_mean:.4f}.ckpt",
+        "compare": ["{m_EndPointError_mean}"],
+        "keep": {"latest": 2, "best": 2},
+    },
+    "validation": [{
+        "type": "strategy",
+        "frequency": "epoch",
+        "checkpoint": True,
+        "tb-metrics-prefix": "Validation:S{n_stage}:{id_stage}:{id_val}/",
+        "metrics": [
+            {"reduce": "mean", "metric": {"type": "epe"}},
+            {"reduce": "mean", "metric": {"type": "loss"}},
+        ],
+        "images": {"prefix": "Validation:S{n_stage}:{id_stage}:{id_val}/i{img_idx}/"},
+    }],
+    "tensorboard": {"path": "tb.{id_model}"},
+}
+
+
+def test_inspector_spec_roundtrip():
+    spec = inspect_.load(INSPECT_CFG)
+    cfg = spec.get_config()
+    spec2 = inspect_.load(cfg)
+    assert spec2.get_config() == cfg
+
+
+def _make_inspected_context(tmp_path, stages, inspect_cfg):
+    spec = models.load(TINY_MODEL)
+    insp_spec = inspect_.load(inspect_cfg)
+    inspector, mgr = insp_spec.build("tiny", tmp_path)
+
+    log = Logger("test")
+    ctx = strategy.TrainingContext(
+        log, tmp_path, strategy.Strategy("continuous", stages), "tiny",
+        spec.model, spec.model.get_adapter(), spec.loss, spec.input,
+        inspector, mgr, loader_args={"num_workers": 0},
+    )
+    return ctx, mgr, inspector
+
+
+def _stage_with_validation(epochs=1, accumulate=1):
+    stage = _make_stage(epochs=epochs, accumulate=accumulate)
+    stage.validation = [strategy.spec.ValidationSpec(
+        name="fake", source=FlowSource(2), batch_size=1, images={0},
+    )]
+    return stage
+
+
+def test_summary_inspector_end_to_end(tmp_path):
+    """One epoch with the full inspector: train metrics + images to TB,
+    epoch validation computes EPE and creates a checkpoint."""
+    ctx, mgr, _ = _make_inspected_context(
+        tmp_path, [_stage_with_validation()], INSPECT_CFG
+    )
+    ctx.run()
+    assert ctx.step == 2
+
+    # validation created checkpoints with the EPE metric in name + entry
+    assert len(mgr.checkpoints) == 1
+    entry = mgr.checkpoints[0]
+    assert "EndPointError/mean" in entry.metrics
+    assert entry.path.exists()
+    assert "-epe" in entry.path.name
+
+    # checkpoint loads back
+    chkpt = entry.load()
+    assert chkpt.metrics["EndPointError/mean"] == entry.metrics["EndPointError/mean"]
+
+    ctx.inspector.writer.close()
+    events = _read_events(tmp_path / "tb.tiny")
+    tags = {t for t, _, _ in events}
+
+    assert "Train:S0:test.s0/Loss" in tags
+    assert "Train:S0:test.s0/EndPointError/mean" in tags
+    assert "Train:S0:test.s0/LearningRate" in tags
+    assert "Train:S0:test.s0/GradientNorm/total" in tags
+    assert "Train:S0:test.s0/img1" in tags
+    assert "Train:S0:test.s0/flow-est" in tags
+    assert "Validation:S0:test.s0:fake/EndPointError/mean" in tags
+    assert "Validation:S0:test.s0:fake/i0/flow-est" in tags
+
+
+class SometimesInvalidSource(Collection):
+    """FlowSource variant where selected sample indices are invalid."""
+
+    type = "fake-flow-invalid"
+
+    def __init__(self, n=6, invalid=(2,), h=32, w=48):
+        self.inner = FlowSource(n, h, w)
+        self.invalid = set(invalid)
+
+    def __getitem__(self, index):
+        img1, img2, flow, valid, meta = self.inner[index]
+        if index in self.invalid:
+            meta = [Metadata(False, m.dataset_id, m.sample_id,
+                             m.original_extents) for m in meta]
+        return img1, img2, flow, valid, meta
+
+    def __len__(self):
+        return len(self.inner)
+
+    def get_config(self):
+        return {"type": self.type}
+
+    def description(self):
+        return "fake flow with invalid samples"
+
+
+def test_grad_accum_skip_stays_aligned(tmp_path):
+    """An invalid batch mid-accumulation must cost one micro-batch, not
+    desync the host step counter from optax.MultiSteps (VERDICT weak #4)."""
+    from test_strategy import _make_context
+
+    stage = _make_stage(epochs=1, accumulate=2)
+    stage.data = strategy.spec.DataSpec(
+        SometimesInvalidSource(n=5, invalid=(1,)), epochs=1, batch_size=1,
+        shuffle=False,
+    )
+
+    ctx, _ = _make_context(tmp_path, [stage])
+    ctx.run()
+
+    # 5 batches, 1 skipped → 4 executed micro-batches → 2 optimizer steps;
+    # the old (i+1)%accum boundary would have counted only 1
+    assert ctx.step == 2
+
+    # MultiSteps agrees: no partial accumulation left pending
+    from raft_meets_dicl_tpu.strategy.training import TrainingContext  # noqa: F401
+    mini_step = ctx.state.opt_state.mini_step
+    assert int(np.asarray(mini_step)) == 0
+
+
+def test_hooks_activation_and_gradient(tmp_path):
+    """Activation-stats writes mean/var scalars via capture_intermediates;
+    gradient anomaly hook sees grads (and stays silent on healthy ones)."""
+    cfg = dict(INSPECT_CFG)
+    cfg = {k: v for k, v in cfg.items() if k != "validation"}
+    cfg["hooks"] = [
+        {"type": "activation-stats", "modules": ["FeatureEncoderS3_0._Stem_0"],
+         "prefix": "Train/ActivationStats/", "frequency": 1},
+        {"type": "anomalydetect-gradient", "save-checkpoint": True,
+         "checkpoint-fmt": "anomaly-b{n_step}.ckpt"},
+    ]
+
+    ctx, _, inspector = _make_inspected_context(
+        tmp_path, [_make_stage(epochs=1)], cfg
+    )
+    assert inspector.wants_gradients  # grad-norm metric + gradient hook
+    ctx.run()
+
+    ctx.inspector.writer.close()
+    events = _read_events(tmp_path / "tb.tiny")
+    tags = {t for t, _, _ in events}
+
+    act_tags = [t for t in tags
+                if t.startswith("Train/ActivationStats/FeatureEncoderS3_0")]
+    assert act_tags, f"no activation stats written; tags: {sorted(tags)[:20]}"
+    assert any(t.endswith("/mean") for t in act_tags)
+    assert any(t.endswith("/var") for t in act_tags)
+
+    # healthy training: no anomaly checkpoints dumped
+    assert not list(tmp_path.glob("anomaly-*.ckpt"))
+
+
+def test_gradient_anomaly_dumps_checkpoint(tmp_path):
+    """A non-finite gradient triggers the rolling debug checkpoint dump."""
+    import jax.numpy as jnp
+
+    from raft_meets_dicl_tpu.inspect.hooks.anomaly import GradientAnomalyDetector
+
+    ctx, _, inspector = _make_inspected_context(
+        tmp_path, [_make_stage(epochs=1)], INSPECT_CFG
+    )
+    # minimal live context for the dump
+    ctx._ensure_variables(ctx.strategy.stages[0])
+    ctx.current_stage = ctx.strategy.stages[0]
+    ctx.current_stage.index = 0
+    ctx.current_epoch = 0
+    ctx.lr_sched_inst, ctx.lr_sched_epoch = [], []
+
+    hook = GradientAnomalyDetector(checkpoint=True)
+    writer = inspector.writer
+    writer.set_fmtargs({"n_step": 0})
+    hook.register(ctx, writer)
+
+    log = Logger("test")
+    hook.on_grads(log, ctx, {"w": jnp.array([1.0, float("nan")])})
+
+    dumps = list(tmp_path.glob("anomaly_in_gradient-*.ckpt"))
+    assert len(dumps) == 1
+    # the dump is a loadable checkpoint
+    chkpt = strategy.Checkpoint.load(dumps[0])
+    assert chkpt.model == "tiny"
