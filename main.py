@@ -1,0 +1,10 @@
+#!/usr/bin/env python3
+"""Entry point: ./main.py {train, evaluate, checkpoint, gencfg} ...
+
+(reference main.py:1-6)
+"""
+
+from raft_meets_dicl_tpu.main import main
+
+if __name__ == "__main__":
+    main()

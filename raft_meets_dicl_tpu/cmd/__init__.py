@@ -1,0 +1,10 @@
+from . import checkpoint as checkpoint_mod
+from . import eval as eval_mod
+from . import gencfg, train as train_mod
+
+train = train_mod.train
+evaluate = eval_mod.evaluate
+checkpoint = checkpoint_mod.checkpoint
+generate_config = gencfg.generate_config
+
+__all__ = ["train", "evaluate", "checkpoint", "generate_config"]

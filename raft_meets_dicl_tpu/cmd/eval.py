@@ -1,0 +1,229 @@
+"""The ``evaluate`` subcommand: per-sample metrics, reports, flow images.
+
+Capability parity with the reference command (src/cmd/eval.py:112-303): the
+same metric/collector pipeline, per-sample logging, JSON/YAML report, and
+the ten flow-image output formats. The forward passes run through the
+jitted evaluation generator (evaluation.evaluate).
+"""
+
+import logging
+from pathlib import Path
+
+import cv2
+import numpy as np
+
+from .. import data, evaluation, metrics, models, strategy, utils, visual
+
+_DEFAULT_METRICS = Path(__file__).parent.parent.parent / "cfg" / "eval" / "default.yaml"
+
+
+def evaluate(args):
+    utils.logging.setup()
+
+    # model (a full training config's model section is accepted too)
+    logging.info(f"loading model specification, file='{args.model}'")
+    model_cfg = utils.config.load(args.model)
+    if "strategy" in model_cfg:
+        model_cfg = model_cfg["model"]
+
+    spec = models.load(model_cfg)
+    model, loss, input = spec.model, spec.loss, spec.input
+    model_adapter = model.get_adapter()
+
+    logging.info(f"loading checkpoint, file='{args.checkpoint}'")
+    chkpt = strategy.Checkpoint.load(args.checkpoint)
+
+    # metrics
+    metrics_path = args.metrics if args.metrics else _DEFAULT_METRICS
+    logging.info(f"loading metrics specification, file='{metrics_path}'")
+
+    metrics_cfg = utils.config.load(metrics_path)
+    mtx = metrics.Metrics.from_config(metrics_cfg["metrics"])
+    collectors = metrics.Collectors.from_config(metrics_cfg["summary"])
+
+    # data
+    logging.info(f"loading data specification, file='{args.data}'")
+    compute_metrics = not args.flow_only
+
+    dataset = data.load(args.data)
+    loader = input.apply(dataset).jax(compute_metrics).loader(
+        batch_size=args.batch_size, shuffle=False, drop_last=False,
+    )
+
+    # variables from the checkpoint (structure target from a sample init)
+    import jax
+
+    img1, img2, *_ = loader.source[0]
+    variables = model.init(jax.random.PRNGKey(0), img1[:1], img2[:1])
+    variables, _, _ = chkpt.apply(variables=variables)
+
+    path_out = Path(args.output) if args.output else None
+    if path_out is not None:
+        path_out.parent.mkdir(parents=True, exist_ok=True)
+
+    path_flow = Path(args.flow) if args.flow else None
+
+    # visual-format argument plumbing (src/cmd/eval.py:177-204)
+    visual_args = {}
+    if args.flow_mrm:
+        visual_args["mrm"] = float(args.flow_mrm)
+    if args.flow_gamma:
+        visual_args["gamma"] = float(args.flow_gamma)
+
+    visual_dark_args = dict(visual_args)
+    if args.flow_transform:
+        visual_dark_args["transform"] = args.flow_transform
+
+    epe_args = {}
+    if args.epe_cmap is not None:
+        epe_args["cmap"] = args.epe_cmap
+    if args.epe_max is not None:
+        epe_args["vmax"] = float(args.epe_max)
+
+    logging.info(f"evaluating {len(loader.source)} samples")
+
+    output = []
+    ctx_m = metrics.MetricContext()
+
+    for sample in evaluation.evaluate(model, variables, loader):
+        target = sample.target[None] if sample.target is not None else None
+        valid = sample.valid[None] if sample.valid is not None else None
+        est = sample.final[None]
+        out = model_adapter.wrap_result(sample.output, None)
+
+        if target is not None and compute_metrics:
+            sample_loss = float(np.asarray(
+                loss(model, out.output(), target, valid)
+            ))
+            sample_metrs = mtx(ctx_m, est, target, valid, sample_loss)
+
+            output.append({"id": str(sample.meta.sample_id), "metrics": sample_metrs})
+            collectors.collect(sample_metrs)
+
+            info = [f"{k}: {v:.04f}" for k, v in sample_metrs.items()]
+            logging.info(f"sample: {sample.meta.sample_id}, {', '.join(info)}")
+        else:
+            logging.info(f"sample: {sample.meta.sample_id}")
+
+        if path_flow is not None:
+            img1 = (sample.img1 + 1) / 2
+            img2 = (sample.img2 + 1) / 2
+            save_flow_image(
+                path_flow, args.flow_format, sample.meta.sample_id, img1, img2,
+                sample.target, sample.valid, sample.final, out,
+                sample.meta.original_extents, visual_args, visual_dark_args,
+                epe_args,
+            )
+
+    if compute_metrics:
+        logging.info("summary:")
+        for collector in collectors.collectors:
+            info = [f"{k}: {v:.04f}" for k, v in collector.result().items()]
+            logging.info(f"  {collector.type}: {', '.join(info)}")
+
+        if path_out is not None:
+            utils.config.store(path_out, {
+                "samples": output,
+                "summary": collectors.results(),
+            })
+
+
+def save_flow_image(dir, format, sample_id, img1, img2, target, valid, flow,
+                    out, size, visual_args, visual_dark_args, epe_args):
+    """One sample's output in the requested format (src/cmd/eval.py:274-303)."""
+    (h0, h1), (w0, w1) = size
+    flow = flow[h0:h1, w0:w1]
+    img1 = img1[h0:h1, w0:w1]
+    img2 = img2[h0:h1, w0:w1]
+    if target is not None:
+        target = target[h0:h1, w0:w1]
+    if valid is not None:
+        valid = np.asarray(valid[h0:h1, w0:w1], bool)
+
+    formats = {
+        "flow:flo": (data.io.write_flow_mb, [flow], {}, "flo"),
+        "flow:kitti": (data.io.write_flow_kitti, [flow], {}, "png"),
+        "visual:epe": (save_flow_visual_epe, [flow, target, valid], epe_args, "png"),
+        "visual:bp-fl": (save_flow_visual_fl_error, [flow, target, valid], {}, "png"),
+        "visual:flow": (save_flow_visual, [flow], visual_args, "png"),
+        "visual:flow:dark": (save_flow_visual_dark, [flow], visual_dark_args, "png"),
+        "visual:flow:gt": (save_flow_visual, [target], visual_args, "png"),
+        "visual:i1": (save_image, [img1], {}, "png"),
+        "visual:warp:backwards": (save_flow_visual_warp_backwards, [img2, flow], {}, "png"),
+        "visual:intermediate:flow": (save_intermediate_flow_visual, [out], visual_args, "png"),
+    }
+
+    write, wargs, kwargs, ext = formats[format]
+
+    path = Path(dir) / f"{sample_id}.{ext}"
+    path.parent.mkdir(parents=True, exist_ok=True)
+    write(path, *wargs, **kwargs)
+
+
+def _to_u8(img):
+    return (np.clip(img, 0.0, 1.0) * 255).astype(np.uint8)
+
+
+def save_image(path, img, **kwargs):
+    cv2.imwrite(str(path), _to_u8(img[:, :, ::-1]))
+
+
+def save_flow_visual(path, uv, **kwargs):
+    rgba = visual.flow_to_rgba(uv, **kwargs)
+    cv2.imwrite(str(path), _to_u8(visual.utils.rgba_to_bgra(rgba)))
+
+
+def save_flow_visual_dark(path, uv, **kwargs):
+    rgba = visual.flow_to_rgba_dark(uv, **kwargs)
+    cv2.imwrite(str(path), _to_u8(visual.utils.rgba_to_bgra(rgba)))
+
+
+def save_flow_visual_epe(path, uv, uv_target, mask, cmap="gray", **kwargs):
+    if cmap == "absflow":
+        rgba = visual.end_point_error_abs(uv, uv_target, mask)
+    else:
+        rgba = visual.end_point_error(uv, uv_target, mask, cmap=cmap, **kwargs)
+    cv2.imwrite(str(path), _to_u8(visual.utils.rgba_to_bgra(rgba)))
+
+
+def save_flow_visual_fl_error(path, uv, uv_target, mask):
+    rgba = visual.fl_error(uv, uv_target, mask)
+    cv2.imwrite(str(path), _to_u8(visual.utils.rgba_to_bgra(rgba)))
+
+
+def save_flow_visual_warp_backwards(path, img2, flow):
+    cv2.imwrite(str(path), _to_u8(visual.warp_backwards(img2, flow)[:, :, ::-1]))
+
+
+def save_intermediate_flow_visual(path, output, mrm=None, **kwargs):
+    """Dump every intermediate flow, magnitude-normalized across levels by
+    width ratio (src/cmd/eval.py:338-383)."""
+    inter = output.intermediate_flow()
+
+    flat = {}
+
+    def unpack(node, key=""):
+        if isinstance(node, (list, tuple)):
+            for i, x in enumerate(node):
+                unpack(x, f"{key}.{i}")
+        elif isinstance(node, dict):
+            for k, x in node.items():
+                unpack(x, f"{key}.{k}")
+        else:
+            flat[key] = np.asarray(node)[0]  # batch size 1 guaranteed here
+
+    unpack(inter)
+
+    ref_width = max(uv.shape[1] for uv in flat.values())
+
+    if mrm is None:
+        mrm = 1e-5
+        for uv in flat.values():
+            level_max = float(np.max(np.linalg.norm(uv, ord=2, axis=-1)))
+            mrm = max(mrm, level_max * ref_width / uv.shape[1])
+
+    path = Path(path)
+    for k, uv in flat.items():
+        p = path.parent / f"{path.stem}{k}{path.suffix}"
+        rgba = visual.flow_to_rgba(uv, mrm=mrm * uv.shape[1] / ref_width, **kwargs)
+        cv2.imwrite(str(p), _to_u8(visual.utils.rgba_to_bgra(rgba)))

@@ -1,0 +1,58 @@
+"""The ``gencfg`` subcommand: merge config parts into one full config.
+
+Capability parity with the reference (src/cmd/gencfg.py:14-103); the output
+is the same reproducible full-config format that ``train --config`` and the
+eval command's model-section extraction accept.
+"""
+
+import datetime
+import logging
+from pathlib import Path
+
+from .. import inspect as inspect_
+from .. import models, strategy, utils
+from .train import Environment, load_config_parts
+
+
+def generate_config(args):
+    timestamp = datetime.datetime.now()
+
+    utils.logging.setup()
+
+    cfg_seeds, cfg_env, cfg_model, cfg_strat, cfg_inspc, base_path = \
+        load_config_parts(args)
+
+    if cfg_seeds is not None:
+        logging.info("seeding: using seeds from config")
+        seeds = utils.seeds.from_config(cfg_seeds)
+    else:
+        seeds = utils.seeds.random_seeds()
+    seeds.apply()
+
+    env = Environment.load(cfg_env)
+
+    if cfg_model is None:
+        raise ValueError("no model configuration specified")
+    model = models.load(cfg_model)
+
+    if cfg_strat is None:
+        raise ValueError("no strategy/data configuration specified")
+    if isinstance(cfg_strat, str):
+        strat = strategy.load(cfg_strat)
+    else:
+        strat = strategy.load(base_path, cfg_strat)
+
+    inspc = inspect_.load(cfg_inspc)
+
+    logging.info(f"storing configuration: file='{args.output}'")
+    utils.config.store(args.output, {
+        "timestamp": timestamp.isoformat(),
+        "commit": utils.vcs.get_git_head_hash(),
+        "cwd": str(Path.cwd()),
+        "args": {k: v for k, v in vars(args).items() if k != "comment"},
+        "seeds": seeds.get_config(),
+        "model": model.get_config(),
+        "strategy": strat.get_config(),
+        "inspect": inspc.get_config(),
+        "environment": env.get_config(),
+    })

@@ -1,0 +1,252 @@
+"""The ``train`` subcommand: run-dir setup, config assembly, training loop.
+
+Capability parity with the reference command (src/cmd/train.py:45-226),
+TPU-native where the reference is CUDA-native:
+
+- device selection picks the jax platform / device subset and (for more
+  than one device) builds the SPMD data mesh — the reference's
+  ``nn.DataParallel`` wrap (src/cmd/train.py:183-184) has no runtime object
+  here, sharding is part of the compiled step,
+- ``--detect-anomaly`` flips ``jax_debug_nans`` (the jax analog of
+  ``torch.autograd.set_detect_anomaly``),
+- the env config carries loader args plus an ``xla`` section instead of
+  cudnn switches.
+"""
+
+import datetime
+import logging
+import re
+from pathlib import Path
+
+from .. import inspect as inspect_
+from .. import models, parallel, strategy, utils
+from ..strategy.training import TrainingContext
+
+_DEFAULT_ENV = Path(__file__).parent.parent.parent / "cfg" / "env" / "default.yaml"
+_DEFAULT_INSPECT = Path(__file__).parent.parent.parent / "cfg" / "inspect" / "default.yaml"
+
+
+class Environment:
+    """Loader arguments + backend flags (reference Environment,
+    src/cmd/train.py:18-42; cudnn switches become jax/XLA ones)."""
+
+    @classmethod
+    def load(cls, cfg):
+        if isinstance(cfg, (Path, str)):
+            cfg = utils.config.load(cfg)
+
+        return cls(
+            loader_args=cfg.get("loader", {}),
+            debug_nans=cfg.get("jax", {}).get("debug-nans", False),
+            deterministic=cfg.get("jax", {}).get("deterministic", False),
+        )
+
+    def __init__(self, loader_args={}, debug_nans=False, deterministic=False):
+        self.loader_args = dict(loader_args)
+        self.debug_nans = debug_nans
+        self.deterministic = deterministic
+
+    def get_config(self):
+        return {
+            "loader": self.loader_args,
+            "jax": {
+                "debug-nans": self.debug_nans,
+                "deterministic": self.deterministic,
+            },
+        }
+
+    def apply(self):
+        import jax
+
+        if self.debug_nans:
+            jax.config.update("jax_debug_nans", True)
+        if self.deterministic:
+            import os
+
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "--xla_gpu_deterministic_ops" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + " --xla_gpu_deterministic_ops=true"
+                ).strip()
+
+
+def select_devices(device=None, device_ids=None):
+    """Resolve --device/--device-ids to a jax device list.
+
+    ``device`` filters by platform name ('tpu', 'cpu'); ``device_ids`` is a
+    comma-separated index list into that platform's devices. Returns the
+    selected devices (all of the default backend if unspecified).
+    """
+    import jax
+
+    devices = jax.devices(device) if device else jax.devices()
+
+    if device_ids:
+        ids = [int(i.strip()) for i in device_ids.split(",")]
+        devices = [devices[i] for i in ids]
+
+    return devices
+
+
+def load_config_parts(args):
+    """Assemble seed/env/model/strategy/inspect configs from --config plus
+    individual overrides (reference src/cmd/train.py:69-137)."""
+    cfg_seeds = cfg_env = cfg_model = cfg_strat = cfg_inspc = None
+    base_path = "./"
+
+    if getattr(args, "config", None) is not None:
+        logging.info(f"loading configuration: file='{args.config}'")
+        config = utils.config.load(args.config)
+
+        cfg_seeds = config.get("seeds")
+        cfg_model = config.get("model")
+        cfg_strat = config.get("strategy")
+        cfg_inspc = config.get("inspect")
+        cfg_env = config.get("environment")
+        base_path = Path(args.config).parent
+
+    if getattr(args, "seeds", None):
+        cfg_seeds = utils.config.load(args.seeds)
+
+    if getattr(args, "env", None):
+        cfg_env = args.env
+    if cfg_env is None:
+        cfg_env = _DEFAULT_ENV
+
+    if getattr(args, "model", None) is not None:
+        cfg_model = args.model
+    if getattr(args, "data", None) is not None:
+        cfg_strat = args.data
+        base_path = "./"
+    if getattr(args, "inspect", None) is not None:
+        cfg_inspc = args.inspect
+    if cfg_inspc is None:
+        cfg_inspc = _DEFAULT_INSPECT
+
+    return cfg_seeds, cfg_env, cfg_model, cfg_strat, cfg_inspc, base_path
+
+
+def _train(args):
+    timestamp = datetime.datetime.now()
+
+    suffix = ""
+    if args.suffix:
+        suffix = args.suffix if re.match(r"^[./_-].*$", args.suffix) else f"-{args.suffix}"
+
+    path_out = Path(args.output) / (timestamp.strftime("%G.%m.%dT%H.%M.%S") + suffix)
+    path_out.mkdir(parents=True)
+
+    utils.logging.setup(path_out / "main.log")
+    logging.info(f"starting: time is {timestamp}, writing to '{path_out}'")
+    logging.info(f"description: {args.comment if args.comment else '<not available>'}")
+
+    cfg_seeds, cfg_env, cfg_model, cfg_strat, cfg_inspc, base_path = \
+        load_config_parts(args)
+
+    # seeds (apply() seeds host RNGs and yields the root jax key)
+    if args.reproduce or args.seeds:
+        if cfg_seeds is None:
+            raise ValueError("set --reproduce but no seeds specified")
+        logging.info("seeding: using seeds from config")
+        seeds = utils.seeds.from_config(cfg_seeds)
+    else:
+        seeds = utils.seeds.random_seeds()
+    seeds.apply()
+
+    env = Environment.load(cfg_env)
+    env.apply()
+
+    # model
+    if cfg_model is None:
+        raise ValueError("no model configuration specified")
+    if isinstance(cfg_model, str):
+        logging.info(f"loading model configuration: file='{cfg_model}'")
+    model = models.load(cfg_model)
+
+    # strategy
+    if cfg_strat is None:
+        raise ValueError("no strategy/data configuration specified")
+    if isinstance(cfg_strat, str):
+        logging.info(f"loading strategy configuration: file='{cfg_strat}'")
+        strat = strategy.load(cfg_strat)
+    else:
+        strat = strategy.load(base_path, cfg_strat)
+
+    # inspector
+    if isinstance(cfg_inspc, (str, Path)):
+        logging.info(f"loading metrics/inspection configuration: file='{cfg_inspc}'")
+    inspc = inspect_.load(cfg_inspc)
+
+    # reproducibility dump
+    path_config = path_out / "config.json"
+    logging.info(f"writing full configuration to '{path_config}'")
+
+    with open(path_out / "model.txt", "w") as fd:
+        fd.write(repr(model.model.module))
+
+    utils.config.store(path_config, {
+        "timestamp": timestamp.isoformat(),
+        "commit": utils.vcs.get_git_head_hash(),
+        "comment": args.comment if args.comment else "",
+        "cwd": str(Path.cwd()),
+        "args": {k: v for k, v in vars(args).items() if k != "comment"},
+        "seeds": seeds.get_config(),
+        "model": model.get_config(),
+        "strategy": strat.get_config(),
+        "inspect": inspc.get_config(),
+        "environment": env.get_config(),
+    })
+
+    # devices / mesh
+    import jax
+
+    devices = select_devices(args.device, args.device_ids)
+    mesh = parallel.data_mesh(devices=devices) if len(devices) > 1 else None
+    logging.info(
+        f"devices: {len(devices)}× {devices[0].platform} "
+        f"({'SPMD data mesh' if mesh else 'single device'})"
+    )
+
+    # build inspector and checkpoint manager
+    inspector, chkptm = inspc.build(model.id, path_out)
+
+    model_id = model.id
+    model_spec, loss, input = model.model, model.loss, model.input
+    model_adapter = model_spec.get_adapter()
+
+    # checkpoint / resume
+    chkpt = None
+    if args.checkpoint and args.resume:
+        raise ValueError("cannot set both --checkpoint and --resume")
+
+    if args.checkpoint or args.resume:
+        logging.warning(
+            "saved config not sufficient for reproducibility due to checkpoint data"
+        )
+
+    log = utils.logging.Logger()
+    tctx = TrainingContext(
+        log, path_out, strat, model_id, model_spec, model_adapter, loss, input,
+        inspector, chkptm, mesh=mesh, step_limit=args.steps,
+        loader_args=env.loader_args,
+    )
+
+    if args.checkpoint:
+        logging.info(f"loading checkpoint '{args.checkpoint}'")
+        warm = strategy.Checkpoint.load(args.checkpoint)
+        tctx._ensure_variables(strat.stages[args.start_stage or 0])
+        tctx.variables, _, _ = warm.apply(variables=tctx.variables)
+
+    if args.resume:
+        logging.info(f"loading checkpoint '{args.resume}'")
+        chkpt = strategy.Checkpoint.load(args.resume)
+
+    if args.detect_anomaly:
+        log.warn("anomaly detection enabled")
+        jax.config.update("jax_debug_nans", True)
+
+    tctx.run(args.start_stage, args.start_epoch, chkpt)
+
+
+def train(args):
+    utils.debug.run(_train, args, debug=args.debug)

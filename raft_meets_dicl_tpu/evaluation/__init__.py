@@ -44,21 +44,28 @@ _EVAL_FN_CACHE = {}
 _EVAL_FN_CACHE_MAX = 8
 
 
-def _cache_key(model, model_args):
-    """Cache key, or None when any arg can't be keyed exactly.
+def static_args_key(args):
+    """Repr-key an argument dict for memoizing jitted fns, or None when any
+    value can't be keyed exactly.
 
     Array-valued args (e.g. ``flow_init``) are traced into the jit as
     constants, and their reprs truncate — two different arrays could share a
-    key. Such calls bypass the cache instead.
+    key. Such calls must bypass the cache instead. Shared by every jit-fn
+    cache in the framework (here, validation, intermediates capture).
     """
     parts = []
-    for k, v in sorted(model_args.items()):
+    for k, v in sorted(args.items()):
         if hasattr(v, "shape") or (
             isinstance(v, (list, tuple)) and any(hasattr(x, "shape") for x in v)
         ):
             return None
         parts.append((k, repr(v)))
-    return (id(model), tuple(parts))
+    return tuple(parts)
+
+
+def _cache_key(model, model_args):
+    args_key = static_args_key(model_args)
+    return None if args_key is None else (id(model), args_key)
 
 
 def make_eval_fn(model, model_args=None):

@@ -255,12 +255,13 @@ class StrategyValidation(Validation):
 
     def _val_step(self, ctx, stage):
         """Memoized jitted (variables, batch) → (final flow, loss)."""
-        key = (
-            id(ctx.model), id(ctx.loss),
-            tuple(sorted((k, repr(v)) for k, v in stage.model_args.items())),
-            tuple(sorted((k, repr(v)) for k, v in stage.loss_args.items())),
-        )
-        if key in self._val_steps:
+        from ..evaluation import static_args_key
+
+        model_key = static_args_key(stage.model_args)
+        loss_key = static_args_key(stage.loss_args)
+        cacheable = model_key is not None and loss_key is not None
+        key = (id(ctx.model), id(ctx.loss), model_key, loss_key)
+        if cacheable and key in self._val_steps:
             return self._val_steps[key]
 
         model, loss_fn = ctx.model, ctx.loss
@@ -274,7 +275,8 @@ class StrategyValidation(Validation):
             l = loss_fn(model, result.output(), flow, valid, **loss_args)
             return result.final(), l
 
-        self._val_steps[key] = step
+        if cacheable:
+            self._val_steps[key] = step
         return step
 
     def run(self, log, ctx, writer, chkpt, stage, epoch):
@@ -461,11 +463,11 @@ class SummaryInspector(Inspector):
     # -- intermediates capture ----------------------------------------------
 
     def _capture_fn(self, ctx, stage):
-        key = (
-            id(ctx.model), ctx.model.frozen_batchnorm,
-            tuple(sorted((k, repr(v)) for k, v in stage.model_args.items())),
-        )
-        if key in self._capture_fns:
+        from ..evaluation import static_args_key
+
+        args_key = static_args_key(stage.model_args)
+        key = (id(ctx.model), ctx.model.frozen_batchnorm, args_key)
+        if args_key is not None and key in self._capture_fns:
             return self._capture_fns[key]
 
         model = ctx.model
@@ -480,7 +482,8 @@ class SummaryInspector(Inspector):
             )
             return mutated["intermediates"]
 
-        self._capture_fns[key] = fn
+        if args_key is not None:
+            self._capture_fns[key] = fn
         return fn
 
     def _run_intermediate_hooks(self, log, ctx, stage, img1, img2):
@@ -533,7 +536,10 @@ class SummaryInspector(Inspector):
             if h.active and h.needs_grads and grads is not None:
                 h.on_grads(log, ctx, grads)
 
-        self._run_intermediate_hooks(log, ctx, stage, img1, img2)
+        # first micro-batch only: under gradient accumulation ctx.step stays
+        # constant across the group, and the capture forward is expensive
+        if self.batch_index == 0:
+            self._run_intermediate_hooks(log, ctx, stage, img1, img2)
 
         # dump images (first sample, first micro-batch when accumulating)
         if (self.images is not None and ctx.step % self.images.frequency == 0
@@ -598,11 +604,17 @@ def write_images(writer, pfx, i, img1, img2, target, estimate, valid, meta, step
     ft, fe = ft[h0:h1, w0:w1], fe[h0:h1, w0:w1]
     mask = mask[h0:h1, w0:w1]
 
-    # shared motion scale across estimate and ground truth
-    mrm = max(
-        float(np.max(np.linalg.norm(ft, axis=-1))),
-        float(np.max(np.linalg.norm(fe, axis=-1))),
-    )
+    # shared motion scale across estimate and ground truth; invalid pixels
+    # (masked out or non-finite, e.g. KITTI sparse-GT sentinels) must not
+    # inflate or NaN the scale
+    def motion_max(f, m=None):
+        norm = np.linalg.norm(f, axis=-1)
+        if m is not None:
+            norm = norm[m]
+        norm = norm[np.isfinite(norm)]
+        return float(norm.max()) if norm.size else 0.0
+
+    mrm = max(motion_max(ft, mask), motion_max(fe), 1e-5)
 
     ft = visual.flow_to_rgba(ft, mrm=mrm, mask=mask)
     fe = visual.flow_to_rgba(fe, mrm=mrm)

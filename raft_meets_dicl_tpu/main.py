@@ -1,0 +1,154 @@
+"""CLI argument parsing and dispatch.
+
+Flag-surface parity with the reference (src/main.py:34-117); device flags
+select the jax platform / device subset instead of CUDA ordinals, and
+``--detect-anomaly`` maps to ``jax_debug_nans``.
+
+Example usage:
+- basic training
+    ./main.py train --data strategy.yaml --model model.yaml
+    ./main.py train --config config.json
+- warm start (weights only) vs resume (full state)
+    ./main.py train -d data.yaml -m model.yaml --checkpoint chkpt.ckpt
+    ./main.py train --config config.json --resume chkpt.ckpt
+- evaluation with report + flow images
+    ./main.py evaluate -d data.yaml -m model.yaml -c chkpt.ckpt -o report.json
+- checkpoint management
+    ./main.py checkpoint info runs/<ts>/checkpoints --sort '{m_EndPointError_mean}'
+    ./main.py checkpoint trim dir/ --compare '{m_EndPointError_mean}' --keep-best 5
+- full-config generation
+    ./main.py gencfg -o full.json -d strategy.yaml -m model.yaml
+"""
+
+import argparse
+
+from . import cmd
+
+
+def main():
+    def fmtcls(prog):
+        return argparse.HelpFormatter(prog, max_help_position=42)
+
+    parser = argparse.ArgumentParser(
+        description="Optical Flow Estimation (TPU-native)", formatter_class=fmtcls
+    )
+    subp = parser.add_subparsers(dest="command", help="help for command")
+
+    # subcommand: train
+    train = subp.add_parser("train", aliases=["t"], formatter_class=fmtcls,
+                            help="train model")
+    train.add_argument("-c", "--config", help="full training configuration")
+    train.add_argument("-d", "--data", help="training strategy and data")
+    train.add_argument("-m", "--model", help="specification of the model")
+    train.add_argument("-s", "--seeds", help="seed config for initializing RNGs")
+    train.add_argument("-i", "--inspect", help="specification of metrics")
+    train.add_argument("-e", "--env", "--environment", dest="env",
+                       help="environment config")
+    train.add_argument("-o", "--output", default="runs",
+                       help="base output directory [default: %(default)s]")
+    train.add_argument("--device",
+                       help="jax platform to use (tpu, cpu) [default: backend default]")
+    train.add_argument("--device-ids",
+                       help="comma-separated device indices for the SPMD data mesh")
+    train.add_argument("--checkpoint",
+                       help="start with pre-trained model state from checkpoint")
+    train.add_argument("--resume", help="resume training from checkpoint (full state)")
+    train.add_argument("--start-stage", type=int,
+                       help="start with specified stage and skip previous")
+    train.add_argument("--start-epoch", type=int,
+                       help="start with specified epoch and skip previous")
+    train.add_argument("--reproduce", action="store_true", help="use seeds from config")
+    train.add_argument("--debug", action="store_true", help="enter debugger on exception")
+    train.add_argument("--detect-anomaly", action="store_true",
+                       help="enable jax nan-debugging (jax_debug_nans)")
+    train.add_argument("--suffix", "--sfx", dest="suffix",
+                       help="suffix for output directory")
+    train.add_argument("--comment", dest="comment", help="comment to add to config file")
+    train.add_argument("--limit-steps", type=int, dest="steps",
+                       help="limit to a fixed number of steps")
+
+    # subcommand: evaluate
+    eval_ = subp.add_parser("evaluate", aliases=["e", "eval"], formatter_class=fmtcls,
+                            help="evaluate model")
+    eval_.add_argument("-d", "--data", required=True, help="evaluation dataset")
+    eval_.add_argument("-m", "--model", required=True, help="the model to use")
+    eval_.add_argument("-c", "--checkpoint", required=True, help="the checkpoint to load")
+    eval_.add_argument("-b", "--batch-size", type=int, default=1,
+                       help="batch-size to use for evaluation")
+    eval_.add_argument("-x", "--metrics",
+                       help="specification of metrics to use for evaluation")
+    eval_.add_argument("-o", "--output",
+                       help="write detailed output to this file (json or yaml)")
+    eval_.add_argument("-f", "--flow",
+                       help="compute and write flow images to specified directory")
+    eval_.add_argument("--flow-format", default="visual:flow",
+                       help="output format for flow images [default: %(default)s]")
+    eval_.add_argument("--flow-mrm", type=float,
+                       help="maximum range of motion for visual flow image output")
+    eval_.add_argument("--flow-gamma", type=float,
+                       help="gamma for visual:flow image output")
+    eval_.add_argument("--flow-transform",
+                       help="transform for visual:flow:dark image output")
+    eval_.add_argument("--flow-only", action="store_true",
+                       help="only compute flow images, do not evaluate metrics")
+    eval_.add_argument("--epe-cmap", default="gray",
+                       help="colormap for end-point-error visualization")
+    eval_.add_argument("--epe-max", type=float, default=None,
+                       help="maximum end point error for visualization")
+    eval_.add_argument("--device",
+                       help="jax platform to use (tpu, cpu) [default: backend default]")
+    eval_.add_argument("--device-ids",
+                       help="comma-separated device indices")
+
+    # subcommand: checkpoint
+    chkpt = subp.add_parser("checkpoint", formatter_class=fmtcls,
+                            help="inspect and manage checkpoints")
+    chkpt_sub = chkpt.add_subparsers(dest="subcommand", help="help for subcommand")
+
+    chkpt_info = chkpt_sub.add_parser("info", formatter_class=fmtcls,
+                                      help="show info on checkpoint(s)")
+    chkpt_info.add_argument("file", nargs="+",
+                            help="checkpoint file or directory to search")
+    chkpt_info.add_argument("--sort",
+                            help="expression(s) for sorting checkpoints (comma-separated)")
+
+    chkpt_trim = chkpt_sub.add_parser("trim", formatter_class=fmtcls,
+                                      help="remove bad and/or outdated checkpoints")
+    chkpt_trim.add_argument("directory", nargs="+",
+                            help="directory to search for checkpoints")
+    chkpt_trim.add_argument("--compare",
+                            help="expression(s) for comparing checkpoints (comma-separated)")
+    chkpt_trim.add_argument("--keep-latest", type=int,
+                            help="keep specified number of latest checkpoints")
+    chkpt_trim.add_argument("--keep-best", type=int,
+                            help="keep specified number of best checkpoints")
+
+    # subcommand: gencfg
+    gencfg = subp.add_parser("gencfg", formatter_class=fmtcls,
+                             help="generate full config from parts")
+    gencfg.add_argument("-o", "--output", required=True, help="output file")
+    gencfg.add_argument("-c", "--config", help="full training configuration")
+    gencfg.add_argument("-d", "--data", help="training strategy and data")
+    gencfg.add_argument("-m", "--model", help="specification of the model")
+    gencfg.add_argument("-s", "--seeds", help="seed config for initializing RNGs")
+    gencfg.add_argument("-i", "--inspect", help="specification of metrics")
+    gencfg.add_argument("-e", "--env", "--environment", dest="env",
+                       help="environment config")
+
+    args = parser.parse_args()
+
+    commands = {
+        "checkpoint": cmd.checkpoint,
+        "evaluate": cmd.evaluate,
+        "e": cmd.evaluate,
+        "eval": cmd.evaluate,
+        "gencfg": cmd.generate_config,
+        "train": cmd.train,
+        "t": cmd.train,
+    }
+
+    if args.command is None:
+        parser.print_help()
+        return
+
+    commands[args.command](args)

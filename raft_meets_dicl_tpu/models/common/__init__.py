@@ -1,3 +1,3 @@
-from . import blocks, encoders, grid, hsup, norm, warp
+from . import blocks, corr, encoders, grid, hsup, norm, warp
 
-__all__ = ["blocks", "encoders", "grid", "hsup", "norm", "warp"]
+__all__ = ["blocks", "corr", "encoders", "grid", "hsup", "norm", "warp"]

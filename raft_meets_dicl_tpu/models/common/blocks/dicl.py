@@ -45,7 +45,13 @@ class ConvBlock(nn.Module):
 
 
 class ConvBlockTransposed(nn.Module):
-    """transposed conv (2x up, k=4 s=2 p=1 torch geometry) → norm → relu."""
+    """transposed conv (2x up, k=4 s=2 p=1 torch geometry) → norm → relu.
+
+    flax ``padding='SAME'`` reproduces torch's k4/s2/p1 exactly (out = 2·in,
+    same border alignment — verified bit-exact in f64 against
+    ``F.conv_transpose2d``); explicit pair padding in flax means something
+    different and loses pixels.
+    """
 
     c_out: int
     norm_type: str = "batch"
@@ -54,8 +60,7 @@ class ConvBlockTransposed(nn.Module):
     @nn.compact
     def __call__(self, x, train=False, frozen_bn=False):
         x = nn.ConvTranspose(
-            self.c_out, (4, 4), strides=(2, 2), padding=((1, 1), (1, 1)),
-            use_bias=False,
+            self.c_out, (4, 4), strides=(2, 2), padding="SAME", use_bias=False,
         )(x)
         x = Norm2d(self.norm_type, self.num_groups)(x, train and not frozen_bn)
         return nn.relu(x)
@@ -88,9 +93,9 @@ class GaConv2xBlockTransposed(nn.Module):
 
     @nn.compact
     def __call__(self, x, res, train=False, frozen_bn=False):
+        # 'SAME' = torch k4/s2/p1 geometry (see ConvBlockTransposed)
         x = nn.ConvTranspose(
-            self.c_out, (4, 4), strides=(2, 2), padding=((1, 1), (1, 1)),
-            use_bias=False,
+            self.c_out, (4, 4), strides=(2, 2), padding="SAME", use_bias=False,
         )(x)
         x = nn.relu(x)
 

@@ -1,0 +1,82 @@
+"""Shared pieces of the correlation modules: window sampling + soft-argmax.
+
+The reference samples the (2r+1)² displaced feature windows with
+``F.grid_sample`` per module (src/models/common/corr/dicl.py:26-61 and
+siblings); here one helper owns that lookup, built on the framework's
+bilinear-sample op, with windows ordered by ``ops.corr.window_delta``
+(axis 0 varies dx) so every cost volume in the framework shares one channel
+layout.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ....ops.corr import window_delta
+from ....ops.sample import sample_bilinear
+from ..blocks.dicl import DisplacementAwareProjection
+
+
+def sample_window(f2, coords, radius):
+    """Sample f2 at the (2r+1)² displaced positions around each coordinate.
+
+    f2: (B, H, W, C) features; coords: (B, H, W, 2) pixel positions.
+    Returns (B, du, dv, H, W, C) with zero padding outside — du varies dx.
+    """
+    b, h, w, c = f2.shape
+    k = 2 * radius + 1
+
+    delta = window_delta(radius, coords.dtype)  # (K, K, 2)
+    pos = coords[:, None, None] + delta[None, :, :, None, None]  # (B,K,K,H,W,2)
+
+    sampled = sample_bilinear(
+        f2, pos[..., 0].reshape(b, -1), pos[..., 1].reshape(b, -1)
+    )
+    return sampled.reshape(b, k, k, h, w, c)
+
+
+def stack_pair(f1, f2_window):
+    """Broadcast f1 against the sampled window and stack channels:
+    (B, du, dv, H, W, 2C) matching volume (reference corr/dicl.py:50-55)."""
+    b, du, dv, h, w, c = f2_window.shape
+    f1 = jnp.broadcast_to(f1[:, None, None], (b, du, dv, h, w, c))
+    return jnp.concatenate((f1, f2_window), axis=-1)
+
+
+def soft_argmax_flow(cost, radius, temperature=1.0):
+    """Softmax-weighted displacement readout: cost (B, H, W, (2r+1)²) →
+    flow (B, H, W, 2)."""
+    b, h, w, _ = cost.shape
+    k = 2 * radius + 1
+
+    score = nn.softmax(cost / temperature, axis=-1)
+    delta = window_delta(radius, cost.dtype).reshape(k * k, 2)
+    return jnp.einsum("bhwd,dc->bhwc", score, delta)
+
+
+class SoftArgMaxFlowRegression(nn.Module):
+    """Flow readout from a cost volume (reference corr/dicl.py:64-89)."""
+
+    radius: int
+    temperature: float = 1.0
+
+    @nn.compact
+    def __call__(self, cost):
+        return soft_argmax_flow(cost, self.radius, self.temperature)
+
+
+class SoftArgMaxFlowRegressionWithDap(nn.Module):
+    """Flow readout with its own (trained) DAP applied first
+    (reference corr/dicl.py:92-119)."""
+
+    radius: int
+    temperature: float = 1.0
+
+    @nn.compact
+    def __call__(self, cost):
+        b, h, w, kk = cost.shape
+        k = 2 * self.radius + 1
+
+        vol = cost.reshape(b, h, w, k, k)
+        vol = DisplacementAwareProjection((self.radius, self.radius))(vol)
+        return soft_argmax_flow(vol.reshape(b, h, w, kk), self.radius,
+                                self.temperature)

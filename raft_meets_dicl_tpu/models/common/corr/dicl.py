@@ -1,0 +1,51 @@
+"""DICL correlation module: MatchingNet cost over displaced feature pairs.
+
+Behavioral equivalent of reference src/models/common/corr/dicl.py:8-61 in
+NHWC: sample the second frame's features at the (2r+1)² displaced positions
+around the current flow, stack with frame-1 features, run the MatchingNet
+per displacement (displacements ride the batch axis through the convs), and
+apply the displacement-aware projection.
+"""
+
+import flax.linen as nn
+
+from ..blocks.dicl import DisplacementAwareProjection, MatchingNet
+from .common import (
+    SoftArgMaxFlowRegression,
+    SoftArgMaxFlowRegressionWithDap,
+    sample_window,
+    stack_pair,
+)
+
+__all__ = ["CorrelationModule", "SoftArgMaxFlowRegression",
+           "SoftArgMaxFlowRegressionWithDap"]
+
+
+class CorrelationModule(nn.Module):
+    feature_dim: int
+    radius: int
+    dap_init: str = "identity"
+    norm_type: str = "batch"
+    mnet_scale: float = 1
+
+    @property
+    def output_dim(self):
+        return (2 * self.radius + 1) ** 2
+
+    @nn.compact
+    def __call__(self, f1, f2, coords, dap=True, train=False, frozen_bn=False):
+        b, h, w, _ = f1.shape
+
+        window = sample_window(f2, coords, self.radius)
+        mvol = stack_pair(f1, window)  # (B, du, dv, H, W, 2C)
+
+        cost = MatchingNet(norm_type=self.norm_type, scale=self.mnet_scale)(
+            mvol, train, frozen_bn
+        )  # (B, H, W, du, dv)
+
+        if dap:
+            cost = DisplacementAwareProjection(
+                (self.radius, self.radius), init=self.dap_init
+            )(cost)
+
+        return cost.reshape(b, h, w, self.output_dim)

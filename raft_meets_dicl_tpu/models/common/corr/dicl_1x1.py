@@ -1,0 +1,74 @@
+"""DICL correlation module with a 1x1-conv MatchingNet.
+
+Behavioral equivalent of reference src/models/common/corr/dicl_1x1.py: same
+lookup as the full DICL module but the cost net is three 1x1 conv blocks +
+a biased 1x1 head — per-pixel cost, no spatial context.
+"""
+
+import flax.linen as nn
+
+from ..blocks.dicl import ConvBlock, DisplacementAwareProjection
+from .common import (
+    SoftArgMaxFlowRegression,
+    SoftArgMaxFlowRegressionWithDap,
+    sample_window,
+    stack_pair,
+)
+
+__all__ = ["CorrelationModule", "MatchingNet1x1", "SoftArgMaxFlowRegression",
+           "SoftArgMaxFlowRegressionWithDap"]
+
+
+class MatchingNet1x1(nn.Module):
+    """Pointwise matching net (reference dicl_1x1.py:8-30): displacement
+    axes ride the batch through 1x1 convs."""
+
+    norm_type: str = "batch"
+    scale: float = 1
+
+    @nn.compact
+    def __call__(self, mvol, train=False, frozen_bn=False):
+        b, du, dv, h, w, c = mvol.shape
+        c1 = int(self.scale * 96)
+        c2 = int(self.scale * 128)
+        c3 = int(self.scale * 64)
+
+        x = mvol.reshape(b * du * dv, h, w, c)
+
+        x = ConvBlock(c1, kernel_size=1, norm_type=self.norm_type)(x, train, frozen_bn)
+        x = ConvBlock(c2, kernel_size=1, norm_type=self.norm_type)(x, train, frozen_bn)
+        x = ConvBlock(c3, kernel_size=1, norm_type=self.norm_type)(x, train, frozen_bn)
+        x = nn.Conv(1, (1, 1))(x)  # with bias, like the reference
+
+        cost = x.reshape(b, du, dv, h, w)
+        return cost.transpose(0, 3, 4, 1, 2)  # (B, H, W, du, dv)
+
+
+class CorrelationModule(nn.Module):
+    feature_dim: int
+    radius: int
+    dap_init: str = "identity"
+    norm_type: str = "batch"
+    mnet_scale: float = 1
+
+    @property
+    def output_dim(self):
+        return (2 * self.radius + 1) ** 2
+
+    @nn.compact
+    def __call__(self, f1, f2, coords, dap=True, train=False, frozen_bn=False):
+        b, h, w, _ = f1.shape
+
+        window = sample_window(f2, coords, self.radius)
+        mvol = stack_pair(f1, window)
+
+        cost = MatchingNet1x1(norm_type=self.norm_type, scale=self.mnet_scale)(
+            mvol, train, frozen_bn
+        )
+
+        if dap:
+            cost = DisplacementAwareProjection(
+                (self.radius, self.radius), init=self.dap_init
+            )(cost)
+
+        return cost.reshape(b, h, w, self.output_dim)

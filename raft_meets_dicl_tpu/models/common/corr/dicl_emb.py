@@ -1,0 +1,113 @@
+"""DICL correlation module with pair embeddings.
+
+Behavioral equivalent of reference src/models/common/corr/dicl_emb.py: the
+matching volume gains the window offsets as positional-encoding channels, a
+pointwise pair-embedding net produces per-displacement embeddings, and the
+(DAP-weighted) cost softmax attends over them — the module outputs the cost
+volume concatenated with the attended embedding.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ....ops.corr import window_delta
+from ..blocks.dicl import DisplacementAwareProjection, MatchingNet
+from .common import soft_argmax_flow, sample_window, stack_pair
+
+__all__ = ["CorrelationModule", "PairEmbedding", "SoftArgMaxFlowRegression",
+           "SoftArgMaxFlowRegressionWithDap"]
+
+
+class PairEmbedding(nn.Module):
+    """Pointwise embedding of stacked feature pairs
+    (reference dicl_emb.py:8-29)."""
+
+    output_dim: int = 32
+
+    @nn.compact
+    def __call__(self, fstack):
+        b, du, dv, h, w, c = fstack.shape
+
+        x = fstack.reshape(b * du * dv, h, w, c)
+        x = nn.relu(nn.Conv(48, (1, 1))(x))
+        x = nn.relu(nn.Conv(64, (1, 1))(x))
+        x = nn.Conv(self.output_dim, (1, 1))(x)
+
+        return x.reshape(b, du, dv, h, w, self.output_dim)
+
+
+class CorrelationModule(nn.Module):
+    feature_dim: int
+    radius: int
+    embedding_dim: int = 32
+    dap_init: str = "identity"
+    norm_type: str = "batch"
+
+    @property
+    def output_dim(self):
+        return (2 * self.radius + 1) ** 2 + self.embedding_dim
+
+    @nn.compact
+    def __call__(self, f1, f2, coords, dap=True, train=False, frozen_bn=False):
+        b, h, w, _ = f1.shape
+        k = 2 * self.radius + 1
+
+        window = sample_window(f2, coords, self.radius)
+        mvol = stack_pair(f1, window)  # (B, du, dv, H, W, 2C)
+
+        # window offsets as positional encodings (dicl_emb.py:78-83)
+        delta = window_delta(self.radius, mvol.dtype)  # (K, K, 2)
+        delta = jnp.broadcast_to(
+            delta[None, :, :, None, None, :], (b, k, k, h, w, 2)
+        )
+        mvol = jnp.concatenate((mvol, delta), axis=-1)
+
+        cost = MatchingNet(norm_type=self.norm_type)(mvol, train, frozen_bn)
+        emb = PairEmbedding(self.embedding_dim)(mvol)  # (B, du, dv, H, W, E)
+
+        score = cost
+        if dap:
+            score = DisplacementAwareProjection(
+                (self.radius, self.radius), init=self.dap_init
+            )(cost)
+
+        # attention over the displacement candidates
+        score = nn.softmax(score.reshape(b, h, w, k * k), axis=-1)
+        emb = emb.transpose(0, 3, 4, 1, 2, 5).reshape(b, h, w, k * k, -1)
+        attended = jnp.einsum("bhwd,bhwde->bhwe", score, emb)
+
+        return jnp.concatenate(
+            (cost.reshape(b, h, w, k * k), attended), axis=-1
+        )
+
+
+class SoftArgMaxFlowRegression(nn.Module):
+    """Readout over the cost slice of the (cost ++ embedding) output.
+
+    The reference version (dicl_emb.py:107-135) slices then regresses; the
+    embedding channels are ignored for flow.
+    """
+
+    radius: int
+    temperature: float = 1.0
+
+    @nn.compact
+    def __call__(self, out):
+        k2 = (2 * self.radius + 1) ** 2
+        return soft_argmax_flow(out[..., :k2], self.radius, self.temperature)
+
+
+class SoftArgMaxFlowRegressionWithDap(nn.Module):
+    radius: int
+    temperature: float = 1.0
+
+    @nn.compact
+    def __call__(self, out):
+        b, h, w, _ = out.shape
+        k = 2 * self.radius + 1
+        k2 = k * k
+
+        vol = out[..., :k2].reshape(b, h, w, k, k)
+        vol = DisplacementAwareProjection((self.radius, self.radius))(vol)
+        return soft_argmax_flow(vol.reshape(b, h, w, k2), self.radius,
+                                self.temperature)

@@ -1,0 +1,46 @@
+"""Dot-product correlation module (single-level windowed, à la RAFT).
+
+Behavioral equivalent of reference src/models/common/corr/dot.py:8-66 in
+NHWC: instead of a learned MatchingNet cost, the displaced-window cost is
+the normalized dot product of the feature vectors — computed by the
+framework's on-the-fly windowed-correlation op (no materialized volume),
+then passed through the DAP.
+"""
+
+import flax.linen as nn
+
+from ....ops.corr import windowed_correlation
+from ..blocks.dicl import DisplacementAwareProjection
+from .common import (
+    SoftArgMaxFlowRegression,
+    SoftArgMaxFlowRegressionWithDap,
+)
+
+__all__ = ["CorrelationModule", "SoftArgMaxFlowRegression",
+           "SoftArgMaxFlowRegressionWithDap"]
+
+
+class CorrelationModule(nn.Module):
+    radius: int
+    dap_init: str = "identity"
+
+    @property
+    def output_dim(self):
+        return (2 * self.radius + 1) ** 2
+
+    @nn.compact
+    def __call__(self, f1, f2, coords, dap=True, train=False, frozen_bn=False):
+        b, h, w, _ = f1.shape
+        k = 2 * self.radius + 1
+
+        # dot(f1[p], f2[c + d]) / sqrt(C) over the window, channels (dx, dy)
+        cost = windowed_correlation(f1, f2, coords, self.radius, scale=1.0)
+
+        if dap:
+            vol = cost.reshape(b, h, w, k, k)
+            vol = DisplacementAwareProjection(
+                (self.radius, self.radius), init=self.dap_init
+            )(vol)
+            cost = vol.reshape(b, h, w, k * k)
+
+        return cost

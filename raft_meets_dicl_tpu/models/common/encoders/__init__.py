@@ -7,18 +7,42 @@ __init__.py:7-61): families ``raft``, ``dicl``, ``raft-avgpool``,
 Families are filled in as the model zoo grows; unknown names raise.
 """
 
-from . import raft
+from . import dicl, raft
 
-# families are registered here as their modules get built
-_S3_FAMILIES = {"raft": lambda: raft.FeatureEncoderS3}
-_PYRAMID_FAMILIES = {"raft": lambda: raft.FeatureEncoderPyramid}
+# families are registered here as their modules get built; each entry is a
+# builder (output_dim, norm_type, dropout, **kwargs) → module, pyramid
+# builders additionally take ``levels`` first
+_S3_FAMILIES = {
+    "raft": lambda output_dim, norm_type, dropout, **kw:
+        raft.FeatureEncoderS3(output_dim=output_dim, norm_type=norm_type,
+                              dropout=dropout, **kw),
+    "dicl": lambda output_dim, norm_type, dropout, **kw:
+        dicl.s3(output_dim=output_dim, norm_type=norm_type,
+                **_reject_dropout(dropout, kw)),
+}
+_PYRAMID_FAMILIES = {
+    "raft": lambda levels, output_dim, norm_type, dropout, **kw:
+        raft.FeatureEncoderPyramid(output_dim=output_dim, levels=levels,
+                                   norm_type=norm_type, dropout=dropout, **kw),
+    "dicl": lambda levels, output_dim, norm_type, dropout, **kw:
+        dicl.pyramid(levels, output_dim=output_dim, norm_type=norm_type,
+                     **_reject_dropout(dropout, kw)),
+}
 
 _KNOWN_FAMILIES = ("raft", "raft-avgpool", "raft-maxpool", "dicl", "rfpm-raft")
 
 
+def _reject_dropout(dropout, kwargs):
+    """GA-Net encoders have no dropout (reference dicl/*.py take none) —
+    silently ignoring a configured rate would fake regularization."""
+    if dropout:
+        raise ValueError("the 'dicl' encoder family does not support dropout")
+    return kwargs
+
+
 def _resolve(families, encoder_type):
     if encoder_type in families:
-        return families[encoder_type]()
+        return families[encoder_type]
     if encoder_type in _KNOWN_FAMILIES:
         raise NotImplementedError(
             f"encoder family '{encoder_type}' is not implemented yet"
@@ -27,18 +51,15 @@ def _resolve(families, encoder_type):
 
 
 def make_encoder_s3(encoder_type, output_dim, norm_type, dropout, **kwargs):
-    cls = _resolve(_S3_FAMILIES, encoder_type)
-    return cls(output_dim=output_dim, norm_type=norm_type, dropout=dropout, **kwargs)
+    build = _resolve(_S3_FAMILIES, encoder_type)
+    return build(output_dim, norm_type, dropout, **kwargs)
 
 
 def _make_pyramid(encoder_type, levels, output_dim, norm_type, dropout, **kwargs):
     if encoder_type in ("raft-avgpool", "raft-maxpool"):
         kwargs = {"pool_type": encoder_type.removeprefix("raft-")[:-4], **kwargs}
-    cls = _resolve(_PYRAMID_FAMILIES, encoder_type)
-    return cls(
-        output_dim=output_dim, levels=levels, norm_type=norm_type,
-        dropout=dropout, **kwargs
-    )
+    build = _resolve(_PYRAMID_FAMILIES, encoder_type)
+    return build(levels, output_dim, norm_type, dropout, **kwargs)
 
 
 def make_encoder_p34(encoder_type, output_dim, norm_type, dropout, **kwargs):

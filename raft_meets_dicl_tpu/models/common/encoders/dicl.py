@@ -1,0 +1,115 @@
+"""GA-Net feature encoders for the DICL family (Flax, NHWC).
+
+The reference ships five hand-unrolled variants of the same hourglass
+(src/models/common/encoders/dicl/{s3,p26,p34,p35,p36}.py — "Guided
+Aggregation Net for End-to-end Stereo Matching"): a strided conv ladder
+down to depth D, a transposed-conv ladder back up, a second strided ladder
+(each rung fused with the previous ladder's same-resolution features), and
+a final up-ladder emitting output heads at the requested levels. Here that
+is ONE parametric module; the variants are (depth, out_levels) instances.
+
+Level numbering: level 0 is H/2 (the stem output), level i is H/2^(i+1) —
+so the reference's s3 output (H/8) is level 2, p26's outputs (H/4..H/64)
+are levels 1..5.
+"""
+
+from typing import Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..blocks.dicl import ConvBlock, GaConv2xBlock, GaConv2xBlockTransposed
+
+# channels per level: stem = 32 (H/2), then one stage per downsample
+_CHANNELS = (32, 48, 64, 96, 128, 160, 192)
+
+
+class FeatureEncoderGa(nn.Module):
+    """Parametric GA-Net hourglass: down D, up, down, up-with-heads.
+
+    Returns a tuple of features finest-first at ``out_levels`` (or a single
+    array when only one level is requested). Accepts an ``(img1, img2)``
+    tuple for the shared-batch pair trick like the RAFT encoders.
+    """
+
+    output_dim: int = 32
+    depth: int = 3
+    out_levels: Tuple[int, ...] = (2,)
+    norm_type: str = "batch"
+
+    @nn.compact
+    def __call__(self, x, train=False, frozen_bn=False):
+        depth = self.depth
+        out_levels = sorted(self.out_levels)
+        assert 1 <= min(out_levels) and max(out_levels) < depth
+
+        paired = isinstance(x, (tuple, list))
+        if paired:
+            n = x[0].shape[0]
+            x = jnp.concatenate(x, axis=0)
+
+        nt = self.norm_type
+
+        # stem: three 3x3 convs, middle one strided (→ level 0, H/2)
+        x = ConvBlock(_CHANNELS[0], norm_type=nt)(x, train, frozen_bn)
+        x = ConvBlock(_CHANNELS[0], stride=2, norm_type=nt)(x, train, frozen_bn)
+        x = ConvBlock(_CHANNELS[0], norm_type=nt)(x, train, frozen_bn)
+
+        res = {0: x}
+
+        # first down-ladder
+        for i in range(1, depth + 1):
+            x = ConvBlock(_CHANNELS[i], stride=2, norm_type=nt)(x, train, frozen_bn)
+            res[i] = x
+
+        # up-ladder, refreshing the skip features
+        for i in range(depth, 0, -1):
+            x = GaConv2xBlockTransposed(_CHANNELS[i - 1], norm_type=nt)(
+                x, res[i - 1], train, frozen_bn
+            )
+            res[i - 1] = x
+
+        # second down-ladder, fusing the refreshed skips
+        for i in range(1, depth + 1):
+            x = GaConv2xBlock(_CHANNELS[i], norm_type=nt)(x, res[i], train, frozen_bn)
+            res[i] = x
+
+        # final up-ladder with output heads at the requested levels
+        outputs = {}
+        for i in range(depth, min(out_levels), -1):
+            x = GaConv2xBlockTransposed(_CHANNELS[i - 1], norm_type=nt)(
+                x, res[i - 1], train, frozen_bn
+            )
+            if i - 1 in out_levels:
+                outputs[i - 1] = ConvBlock(self.output_dim, norm_type=nt)(
+                    x, train, frozen_bn
+                )
+
+        outs = tuple(outputs[lvl] for lvl in out_levels)  # finest first
+
+        if paired:
+            if len(outs) == 1:
+                return outs[0][:n], outs[0][n:]
+            return tuple(o[:n] for o in outs), tuple(o[n:] for o in outs)
+        return outs[0] if len(outs) == 1 else outs
+
+
+def s3(output_dim, norm_type="batch", **kwargs):
+    """Single-scale 1/8 (reference dicl/s3.py)."""
+    return FeatureEncoderGa(output_dim=output_dim, depth=3, out_levels=(2,),
+                            norm_type=norm_type, **kwargs)
+
+
+def p26(output_dim, norm_type="batch", **kwargs):
+    """1/4 .. 1/64 pyramid for the DICL baseline (reference dicl/p26.py)."""
+    return FeatureEncoderGa(output_dim=output_dim, depth=6,
+                            out_levels=(1, 2, 3, 4, 5), norm_type=norm_type,
+                            **kwargs)
+
+
+def pyramid(levels, output_dim, norm_type="batch", **kwargs):
+    """1/8 .. 1/(8·2^(levels-1)) pyramids: levels 2/3/4 ≈ p34/p35/p36."""
+    out_levels = tuple(range(2, 2 + levels))
+    return FeatureEncoderGa(output_dim=output_dim, depth=max(out_levels) + 1,
+                            out_levels=out_levels, norm_type=norm_type,
+                            **kwargs)

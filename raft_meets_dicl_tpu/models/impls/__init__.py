@@ -1,5 +1,5 @@
 """Model zoo. Importing this package registers all model/loss types."""
 
-from . import raft
+from . import dicl, raft, raft_dicl_sl
 
-__all__ = ["raft"]
+__all__ = ["dicl", "raft", "raft_dicl_sl"]

@@ -1,4 +1,3 @@
-from . import config
-from . import expr
-from . import logging
-from . import seeds
+from . import config, debug, expr, logging, model, seeds, vcs
+
+__all__ = ["config", "debug", "expr", "logging", "model", "seeds", "vcs"]

@@ -3,7 +3,7 @@
 Capability parity with reference src/visual/__init__.py.
 """
 
-from . import bad_pixel, epe, flow_dark, flow_mb, imshow, warp
+from . import bad_pixel, epe, flow_dark, flow_mb, imshow, utils, warp
 
 end_point_error = epe.end_point_error
 end_point_error_abs = epe.end_point_error_abs
@@ -17,7 +17,7 @@ show_flow = imshow.show_flow
 show_flow_dark = imshow.show_flow_dark
 
 __all__ = [
-    "bad_pixel", "epe", "flow_dark", "flow_mb", "imshow", "warp",
+    "bad_pixel", "epe", "flow_dark", "flow_mb", "imshow", "utils", "warp",
     "end_point_error", "end_point_error_abs", "fl_error", "flow_to_rgba",
     "flow_to_rgba_dark", "warp_backwards", "show_image", "show_flow",
     "show_flow_dark",

@@ -1,0 +1,8 @@
+"""Small color-layout helpers (reference src/visual/utils.py)."""
+
+import numpy as np
+
+
+def rgba_to_bgra(rgba):
+    """RGBA → BGRA channel swap for cv2 writers."""
+    return np.ascontiguousarray(np.asarray(rgba)[..., [2, 1, 0, 3]])

@@ -1,0 +1,284 @@
+"""Model-zoo wave 1 tests: corr modules, GA-Net encoders, DICL models,
+and the raft+dicl/sl hybrid."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import raft_meets_dicl_tpu.models as models
+from raft_meets_dicl_tpu.models.common import corr, encoders
+from raft_meets_dicl_tpu.models.impls.dicl import (
+    displaced_pair_volume,
+    flow_entropy,
+    soft_argmin_flow,
+)
+from raft_meets_dicl_tpu.ops.warp import coordinate_grid
+
+RNG = jax.random.PRNGKey(0)
+
+
+# -- correlation modules -----------------------------------------------------
+
+
+@pytest.mark.parametrize("ty", ["dicl", "dicl-1x1", "dicl-emb", "dot"])
+def test_cmod_shapes_and_readout(ty):
+    b, h, w, c, r = 2, 8, 12, 8, 2
+    f1 = jnp.asarray(np.random.RandomState(0).randn(b, h, w, c), jnp.float32)
+    f2 = jnp.asarray(np.random.RandomState(1).randn(b, h, w, c), jnp.float32)
+    coords = coordinate_grid(b, h, w)
+
+    m = corr.make_cmod(ty, feature_dim=c, radius=r)
+    v = m.init(RNG, f1, f2, coords)
+    out = m.apply(v, f1, f2, coords)
+
+    assert out.shape == (b, h, w, m.output_dim)
+    assert bool(jnp.all(jnp.isfinite(out)))
+
+    for reg_ty in ("softargmax", "softargmax+dap"):
+        reg = corr.make_flow_regression(ty, reg_ty, r)
+        flow = reg.apply(reg.init(RNG, out), out)
+        assert flow.shape == (b, h, w, 2)
+
+
+def test_cmod_unknown_type():
+    with pytest.raises(ValueError):
+        corr.make_cmod("nope", feature_dim=8, radius=2)
+    with pytest.raises(ValueError):
+        corr.make_flow_regression("dicl", "nope", 2)
+
+
+def test_soft_argmax_flow_uniform_is_zero():
+    # uniform cost → expectation of symmetric displacements = 0
+    cost = jnp.zeros((1, 4, 5, 25))
+    flow = corr.common.soft_argmax_flow(cost, radius=2)
+    assert np.allclose(np.asarray(flow), 0.0, atol=1e-6)
+
+
+def test_soft_argmax_flow_peak_reads_displacement():
+    # a strong peak at window index (dx=+2, dy=-1) reads that displacement
+    r, k = 2, 5
+    cost = np.zeros((1, 3, 3, k * k), np.float32)
+    dx, dy = 2, -1
+    idx = (dx + r) * k + (dy + r)  # channels ordered (dx, dy) row-major
+    cost[..., idx] = 50.0
+    flow = corr.common.soft_argmax_flow(jnp.asarray(cost), radius=r)
+    assert np.allclose(np.asarray(flow[..., 0]), dx, atol=1e-3)
+    assert np.allclose(np.asarray(flow[..., 1]), dy, atol=1e-3)
+
+
+def test_dot_cmod_matches_manual_dot():
+    """dot cmod without DAP = normalized window dot product at grid coords."""
+    b, h, w, c, r = 1, 6, 7, 4, 1
+    rs = np.random.RandomState(2)
+    f1 = jnp.asarray(rs.randn(b, h, w, c), jnp.float32)
+    f2 = jnp.asarray(rs.randn(b, h, w, c), jnp.float32)
+    coords = coordinate_grid(b, h, w)
+
+    m = corr.make_cmod("dot", feature_dim=c, radius=r)
+    v = m.init(RNG, f1, f2, coords)
+    out = np.asarray(m.apply(v, f1, f2, coords, dap=False))
+
+    # manual: at integer grid coords the window samples are exact pixels
+    f2n = np.asarray(f2)
+    for (y, x) in [(2, 3), (1, 1)]:
+        for i, (dx, dy) in enumerate(
+            (dx, dy) for dx in (-1, 0, 1) for dy in (-1, 0, 1)
+        ):
+            yy, xx = y + dy, x + dx
+            expect = float(np.dot(np.asarray(f1)[0, y, x], f2n[0, yy, xx]))
+            expect /= np.sqrt(c)
+            assert out[0, y, x, i] == pytest.approx(expect, abs=1e-4)
+
+
+# -- DICL functional pieces --------------------------------------------------
+
+
+def test_flow_entropy_limits():
+    uniform = jnp.zeros((1, 3, 3, 5, 5))
+    e = np.asarray(flow_entropy(uniform))
+    assert np.allclose(e, 1.0, atol=1e-5)
+
+    peaked = uniform.at[..., 2, 2].set(1e4)
+    e = np.asarray(flow_entropy(peaked))
+    assert np.all(e < 1e-3)
+
+
+def test_soft_argmin_flow_peak():
+    du = dv = 5
+    cost = np.zeros((1, 3, 3, du, dv), np.float32)
+    cost[..., 4, 1] = 50.0  # dx=+2, dy=-1
+    flow = np.asarray(soft_argmin_flow(jnp.asarray(cost)))
+    assert np.allclose(flow[..., 0], 2.0, atol=1e-3)
+    assert np.allclose(flow[..., 1], -1.0, atol=1e-3)
+
+
+def test_displaced_pair_volume_matches_naive():
+    b, h, w, c, r = 1, 5, 6, 3, 1
+    rs = np.random.RandomState(3)
+    f1 = rs.randn(b, h, w, c).astype(np.float32)
+    # avoid exact zeros so the validity mask only triggers out of bounds
+    f2 = (rs.rand(b, h, w, c) + 0.5).astype(np.float32)
+
+    mvol = np.asarray(displaced_pair_volume(
+        jnp.asarray(f1), jnp.asarray(f2), (r, r)
+    ))
+    assert mvol.shape == (b, 2 * r + 1, 2 * r + 1, h, w, 2 * c)
+
+    # naive per-displacement construction (the reference's loop semantics)
+    for i in range(2 * r + 1):
+        for j in range(2 * r + 1):
+            di, dj = i - r, j - r
+            expect = np.zeros((b, h, w, 2 * c), np.float32)
+            for y in range(h):
+                for x in range(w):
+                    yy, xx = y + dj, x + di
+                    if 0 <= yy < h and 0 <= xx < w:
+                        expect[:, y, x, :c] = f1[:, y, x]
+                        expect[:, y, x, c:] = f2[:, yy, xx]
+            assert np.allclose(mvol[:, i, j], expect, atol=1e-6), (di, dj)
+
+
+# -- encoders ----------------------------------------------------------------
+
+
+def test_dicl_encoder_shapes():
+    x = jnp.zeros((1, 128, 64, 3))
+
+    enc = encoders.make_encoder_s3("dicl", output_dim=16, norm_type="batch",
+                                   dropout=0)
+    out = enc.apply(enc.init(RNG, x), x)
+    assert out.shape == (1, 16, 8, 16)
+
+    xp = jnp.zeros((1, 256, 128, 3))  # p26 needs divisibility by 128
+    enc = encoders.dicl.p26(output_dim=8)
+    outs = enc.apply(enc.init(RNG, xp), xp)
+    assert [o.shape[1] for o in outs] == [64, 32, 16, 8, 4]  # H/4 .. H/64
+
+    a, b = enc.apply(enc.init(RNG, (xp, xp)), (xp, xp))
+    assert len(a) == 5 and a[0].shape == outs[0].shape
+
+
+# -- models ------------------------------------------------------------------
+
+
+DICL_TINY = {
+    "name": "dicl tiny", "id": "dicl/tiny",
+    "model": {
+        "type": "dicl/baseline",
+        "parameters": {
+            "displacement-range": {f"level-{l}": [1, 1] for l in (2, 3, 4, 5, 6)},
+            "feature-channels": 4,
+        },
+        "arguments": {"raw": True},
+    },
+    "loss": {
+        "type": "dicl/multiscale",
+        "arguments": {"weights": [1.0, 0.8, 0.75, 0.6, 0.5, 0.4, 0.5, 0.4,
+                                  0.5, 0.4], "ord": 2},
+    },
+    "input": {"padding": {"type": "modulo", "mode": "zeros", "size": [128, 128]}},
+}
+
+
+def test_dicl_baseline_forward_and_loss():
+    spec = models.load(DICL_TINY)
+    m = spec.model
+
+    img = jnp.asarray(np.random.rand(1, 128, 128, 3), jnp.float32)
+    v = jax.jit(lambda: m.init(RNG, img, img))()
+
+    out = jax.jit(lambda v, a, b: m.apply(v, a, b))(v, img, img)
+    assert len(out) == 10  # raw=True: (flow, flow_raw) × 5 levels
+    assert out[0].shape == (1, 32, 32, 2)  # finest level 2 = 1/4 res
+    assert out[-1].shape == (1, 2, 2, 2)
+
+    res = m.get_adapter().wrap_result(out, img.shape[1:3])
+    final = res.final()
+    assert final.shape == (1, 128, 128, 2)
+
+    target = jnp.zeros((1, 128, 128, 2))
+    valid = jnp.ones((1, 128, 128), bool)
+    loss = spec.loss(m, res.output(), target, valid)
+    assert np.isfinite(float(loss))
+
+    # per-sample slicing for eval
+    sliced = res.output(0)
+    assert sliced[0].shape == (1, 32, 32, 2)
+
+
+def test_dicl_baseline_config_roundtrip():
+    spec = models.load(DICL_TINY)
+    cfg = spec.model.get_config()
+    assert cfg["type"] == "dicl/baseline"
+    m2 = models.config.load_model(cfg)
+    assert m2.get_config() == cfg
+
+
+def test_dicl_64to8_forward():
+    cfg = {
+        "type": "dicl/64to8",
+        "parameters": {
+            "displacement-range": {f"level-{l}": [1, 1] for l in (3, 4, 5, 6)},
+            "feature-channels": 4,
+        },
+    }
+    m = models.config.load_model(cfg)
+    img = jnp.asarray(np.random.rand(1, 128, 128, 3), jnp.float32)
+    v = jax.jit(lambda: m.init(RNG, img, img))()
+    out = jax.jit(lambda v, a, b: m.apply(v, a, b))(v, img, img)
+
+    assert len(out) == 4  # raw=False: one flow per level 3..6
+    assert out[0].shape == (1, 16, 16, 2)  # finest = 1/8
+    assert m.get_config()["type"] == "dicl/64to8"
+
+
+SL_TINY = {
+    "name": "sl tiny", "id": "rds/tiny",
+    "model": {
+        "type": "raft+dicl/sl",
+        "parameters": {"corr-radius": 2, "corr-channels": 8,
+                       "context-channels": 8, "recurrent-channels": 8,
+                       "corr-args": {"mnet_scale": 0.125}},
+        "arguments": {"iterations": 2},
+    },
+    "loss": {"type": "raft/sequence"},
+    "input": None,
+}
+
+
+def test_raft_dicl_sl_forward_and_corr_flow():
+    spec = models.load(SL_TINY)
+    m = spec.model
+
+    img = jnp.asarray(np.random.rand(1, 64, 96, 3), jnp.float32)
+    v = jax.jit(lambda: m.init(RNG, img, img, iterations=1))()
+    assert "batch_stats" in v  # the matching net's BN
+
+    out = jax.jit(lambda v, a, b: m.apply(v, a, b, iterations=2))(v, img, img)
+    assert len(out) == 2 and out[0].shape == (1, 64, 96, 2)
+
+    out, bs = jax.jit(
+        lambda v, a, b: m.apply(v, a, b, train=True, iterations=2)
+    )(v, img, img)
+    assert bs  # training updates the matching-net BN stats
+
+    out = jax.jit(
+        lambda v, a, b: m.apply(v, a, b, iterations=2, corr_flow=True)
+    )(v, img, img)
+    assert len(out) == 2 and len(out[0]) == 2 and len(out[1]) == 2
+
+    res = m.get_adapter().wrap_result(out, img.shape[1:3])
+    assert res.final().shape == (1, 64, 96, 2)
+
+    loss = spec.loss(m, out[1], jnp.zeros((1, 64, 96, 2)),
+                     jnp.ones((1, 64, 96), bool))
+    assert np.isfinite(float(loss))
+
+
+def test_raft_dicl_sl_config_roundtrip():
+    spec = models.load(SL_TINY)
+    cfg = spec.model.get_config()
+    assert cfg["type"] == "raft+dicl/sl"
+    m2 = models.config.load_model(cfg)
+    assert m2.get_config() == cfg
