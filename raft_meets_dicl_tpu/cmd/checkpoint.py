@@ -11,6 +11,9 @@ from ..strategy.checkpoint import load_directory
 
 def checkpoint(args):
     commands = {"info": info, "trim": trim}
+    if args.subcommand not in commands:
+        print("usage: checkpoint {info, trim} ... (see --help)")
+        return
     commands[args.subcommand](args)
 
 

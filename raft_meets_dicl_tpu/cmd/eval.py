@@ -16,9 +16,30 @@ from .. import data, evaluation, metrics, models, strategy, utils, visual
 
 _DEFAULT_METRICS = Path(__file__).parent.parent.parent / "cfg" / "eval" / "default.yaml"
 
+FLOW_FORMATS = (
+    "flow:flo", "flow:kitti", "visual:epe", "visual:bp-fl", "visual:flow",
+    "visual:flow:dark", "visual:flow:gt", "visual:i1",
+    "visual:warp:backwards", "visual:intermediate:flow",
+)
+
 
 def evaluate(args):
     utils.logging.setup()
+
+    # fail fast on a bad format — before model load and jit compile
+    if args.flow and args.flow_format not in FLOW_FORMATS:
+        raise ValueError(
+            f"unknown flow format '{args.flow_format}'; "
+            f"choose one of {', '.join(FLOW_FORMATS)}"
+        )
+
+    # device selection (mirrors the train command)
+    import jax
+
+    from .train import select_devices
+
+    devices = select_devices(args.device, args.device_ids)
+    jax.config.update("jax_default_device", devices[0])
 
     # model (a full training config's model section is accepted too)
     logging.info(f"loading model specification, file='{args.model}'")
@@ -51,8 +72,6 @@ def evaluate(args):
     )
 
     # variables from the checkpoint (structure target from a sample init)
-    import jax
-
     img1, img2, *_ = loader.source[0]
     variables = model.init(jax.random.PRNGKey(0), img1[:1], img2[:1])
     variables, _, _ = chkpt.apply(variables=variables)

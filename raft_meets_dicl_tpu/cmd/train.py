@@ -143,6 +143,11 @@ def _train(args):
     cfg_seeds, cfg_env, cfg_model, cfg_strat, cfg_inspc, base_path = \
         load_config_parts(args)
 
+    # env flags must land before anything touches jax (XLA parses flags at
+    # backend init; seeds.apply() creates the first PRNG key)
+    env = Environment.load(cfg_env)
+    env.apply()
+
     # seeds (apply() seeds host RNGs and yields the root jax key)
     if args.reproduce or args.seeds:
         if cfg_seeds is None:
@@ -152,9 +157,6 @@ def _train(args):
     else:
         seeds = utils.seeds.random_seeds()
     seeds.apply()
-
-    env = Environment.load(cfg_env)
-    env.apply()
 
     # model
     if cfg_model is None:
@@ -201,7 +203,13 @@ def _train(args):
     import jax
 
     devices = select_devices(args.device, args.device_ids)
-    mesh = parallel.data_mesh(devices=devices) if len(devices) > 1 else None
+    if len(devices) > 1:
+        mesh = parallel.data_mesh(devices=devices)
+    else:
+        # pin single-device runs to the selected device — without this the
+        # jitted step would fall back to the default backend's device 0
+        mesh = None
+        jax.config.update("jax_default_device", devices[0])
     logging.info(
         f"devices: {len(devices)}× {devices[0].platform} "
         f"({'SPMD data mesh' if mesh else 'single device'})"

@@ -81,7 +81,10 @@ def main():
                        help="write detailed output to this file (json or yaml)")
     eval_.add_argument("-f", "--flow",
                        help="compute and write flow images to specified directory")
+    from .cmd.eval import FLOW_FORMATS
+
     eval_.add_argument("--flow-format", default="visual:flow",
+                       choices=FLOW_FORMATS, metavar="FORMAT",
                        help="output format for flow images [default: %(default)s]")
     eval_.add_argument("--flow-mrm", type=float,
                        help="maximum range of motion for visual flow image output")

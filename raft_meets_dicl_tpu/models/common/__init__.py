@@ -1,3 +1,4 @@
-from . import blocks, corr, encoders, grid, hsup, norm, warp
+from . import adapters, blocks, corr, encoders, grid, hsup, loss, norm, warp
 
-__all__ = ["blocks", "corr", "encoders", "grid", "hsup", "norm", "warp"]
+__all__ = ["adapters", "blocks", "corr", "encoders", "grid", "hsup", "loss",
+           "norm", "warp"]

@@ -1,0 +1,4 @@
+from . import mlseq
+from .mlseq import MultiLevelSequenceAdapter, MultiLevelSequenceResult
+
+__all__ = ["mlseq", "MultiLevelSequenceAdapter", "MultiLevelSequenceResult"]

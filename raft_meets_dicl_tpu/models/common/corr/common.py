@@ -19,10 +19,13 @@ from ..blocks.dicl import DisplacementAwareProjection
 def sample_window(f2, coords, radius):
     """Sample f2 at the (2r+1)² displaced positions around each coordinate.
 
-    f2: (B, H, W, C) features; coords: (B, H, W, 2) pixel positions.
-    Returns (B, du, dv, H, W, C) with zero padding outside — du varies dx.
+    f2: (B, H2, W2, C) features; coords: (B, H, W, 2) pixel positions *into
+    f2's grid* — the two resolutions may differ (multi-level lookups pass
+    coarser feature maps with rescaled coordinates). Returns
+    (B, du, dv, H, W, C) with zero padding outside — du varies dx.
     """
-    b, h, w, c = f2.shape
+    b, h, w = coords.shape[:3]
+    c = f2.shape[-1]
     k = 2 * radius + 1
 
     delta = window_delta(radius, coords.dtype)  # (K, K, 2)

@@ -7,7 +7,7 @@ __init__.py:7-61): families ``raft``, ``dicl``, ``raft-avgpool``,
 Families are filled in as the model zoo grows; unknown names raise.
 """
 
-from . import dicl, raft
+from . import dicl, pool, raft, rfpm
 
 # families are registered here as their modules get built; each entry is a
 # builder (output_dim, norm_type, dropout, **kwargs) → module, pyramid
@@ -19,6 +19,9 @@ _S3_FAMILIES = {
     "dicl": lambda output_dim, norm_type, dropout, **kw:
         dicl.s3(output_dim=output_dim, norm_type=norm_type,
                 **_reject_dropout(dropout, kw)),
+    "rfpm-raft": lambda output_dim, norm_type, dropout, **kw:
+        rfpm.FeatureEncoderRfpm(output_dim=output_dim, levels=1,
+                                norm_type=norm_type, dropout=dropout, **kw),
 }
 _PYRAMID_FAMILIES = {
     "raft": lambda levels, output_dim, norm_type, dropout, **kw:
@@ -27,6 +30,15 @@ _PYRAMID_FAMILIES = {
     "dicl": lambda levels, output_dim, norm_type, dropout, **kw:
         dicl.pyramid(levels, output_dim=output_dim, norm_type=norm_type,
                      **_reject_dropout(dropout, kw)),
+    "raft-avgpool": lambda levels, output_dim, norm_type, dropout, **kw:
+        pool.FeatureEncoderPool(output_dim=output_dim, levels=levels,
+                                norm_type=norm_type, dropout=dropout, **kw),
+    "raft-maxpool": lambda levels, output_dim, norm_type, dropout, **kw:
+        pool.FeatureEncoderPool(output_dim=output_dim, levels=levels,
+                                norm_type=norm_type, dropout=dropout, **kw),
+    "rfpm-raft": lambda levels, output_dim, norm_type, dropout, **kw:
+        rfpm.FeatureEncoderRfpm(output_dim=output_dim, levels=levels,
+                                norm_type=norm_type, dropout=dropout, **kw),
 }
 
 _KNOWN_FAMILIES = ("raft", "raft-avgpool", "raft-maxpool", "dicl", "rfpm-raft")

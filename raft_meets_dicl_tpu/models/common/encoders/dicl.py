@@ -36,6 +36,7 @@ class FeatureEncoderGa(nn.Module):
     depth: int = 3
     out_levels: Tuple[int, ...] = (2,)
     norm_type: str = "batch"
+    heads: bool = True  # False: raw ladder features (varying channels)
 
     @nn.compact
     def __call__(self, x, train=False, frozen_bn=False):
@@ -81,9 +82,12 @@ class FeatureEncoderGa(nn.Module):
                 x, res[i - 1], train, frozen_bn
             )
             if i - 1 in out_levels:
-                outputs[i - 1] = ConvBlock(self.output_dim, norm_type=nt)(
-                    x, train, frozen_bn
-                )
+                if self.heads:
+                    outputs[i - 1] = ConvBlock(self.output_dim, norm_type=nt)(
+                        x, train, frozen_bn
+                    )
+                else:
+                    outputs[i - 1] = x
 
         outs = tuple(outputs[lvl] for lvl in out_levels)  # finest first
 

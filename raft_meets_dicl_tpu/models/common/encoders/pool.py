@@ -1,0 +1,57 @@
+"""Pooled pyramid encoders: a single-scale RAFT encoding, avg/max-pooled
+for the coarser levels (Flax, NHWC).
+
+Behavioral equivalent of reference src/models/common/encoders/pool/p3*.py —
+three hand-written variants of one structure: the s3 trunk produces the
+1/8 features, every coarser level is a 2x pool of the previous one, with
+per-level channel dropout.
+"""
+
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ....ops.pool import avg_pool2d, max_pool2d
+from .raft import _Stem, _drop2d
+from ..blocks.raft import kaiming_normal
+
+
+class FeatureEncoderPool(nn.Module):
+    """(B, H, W, 3) → tuple of features at 1/8 .. 1/(8·2^(levels-1))."""
+
+    output_dim: int = 128
+    levels: int = 2
+    norm_type: str = "batch"
+    dropout: float = 0.0
+    pool_type: str = "avg"
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, train=False, frozen_bn=False) -> Tuple:
+        if self.pool_type not in ("avg", "max"):
+            raise ValueError(f"invalid pool_type value: '{self.pool_type}'")
+        pool = avg_pool2d if self.pool_type == "avg" else max_pool2d
+
+        paired = isinstance(x, (tuple, list))
+        if paired:
+            n = x[0].shape[0]
+            x = jnp.concatenate(x, axis=0)
+
+        x = _Stem(self.norm_type, dtype=self.dtype)(x, train, frozen_bn)
+        x = nn.Conv(self.output_dim, (1, 1), kernel_init=kaiming_normal,
+                    dtype=self.dtype)(x)
+
+        outputs = []
+        for i in range(self.levels):
+            if i > 0:
+                x = pool(x, 2)
+            out = _drop2d(x, self.dropout, train) if self.dropout > 0 else x
+            outputs.append(out)
+
+        if paired:
+            return (
+                tuple(o[:n] for o in outputs),
+                tuple(o[n:] for o in outputs),
+            )
+        return tuple(outputs)

@@ -1,0 +1,4 @@
+from . import mlseq
+from .mlseq import MultiLevelSequenceLoss, upsample_flow_to
+
+__all__ = ["mlseq", "MultiLevelSequenceLoss", "upsample_flow_to"]

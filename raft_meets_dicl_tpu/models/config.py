@@ -32,10 +32,14 @@ def register_loss(cls):
 
 
 def model_types():
+    from . import impls  # noqa: F401 — triggers registration
+
     return sorted(_MODELS)
 
 
 def loss_types():
+    from . import impls  # noqa: F401 — triggers registration
+
     return sorted(_LOSSES)
 
 

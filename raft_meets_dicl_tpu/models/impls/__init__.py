@@ -1,5 +1,17 @@
 """Model zoo. Importing this package registers all model/loss types."""
 
-from . import dicl, raft, raft_dicl_sl
+from ..common import loss as _common_loss  # noqa: F401 — registers mlseq
+from . import (
+    dicl,
+    outdated,
+    raft,
+    raft_dicl_ctf,
+    raft_dicl_ml,
+    raft_dicl_sl,
+    raft_fs,
+    raft_sl,
+    raft_sl_ctf,
+)
 
-__all__ = ["dicl", "raft", "raft_dicl_sl"]
+__all__ = ["dicl", "outdated", "raft", "raft_dicl_ctf", "raft_dicl_ml",
+           "raft_dicl_sl", "raft_fs", "raft_sl", "raft_sl_ctf"]

@@ -1,0 +1,5 @@
+"""Kept-registered experiments (reference src/models/impls/outdated/)."""
+
+from . import raft_cl, raft_dicl_sl_ca, wip_recwarp, wip_warp
+
+__all__ = ["raft_cl", "raft_dicl_sl_ca", "wip_recwarp", "wip_warp"]

@@ -1,0 +1,386 @@
+"""RAFT+DICL multi-level lookup hybrid.
+
+TPU-native (Flax, NHWC) implementation of the capabilities of reference
+src/models/impls/raft_dicl_ml.py: asymmetric encoders — frame 1 as a
+dilated feature *stack* at 1/8 resolution, frame 2 as a strided feature
+*pyramid* (or a pooled variant for both) — and one fused correlation
+module that samples every level around a single 1/8 flow estimate and
+runs shared-or-per-level MatchingNets, with DAP applied per level
+('separate') or across all levels at once ('full').
+"""
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ...ops.pool import avg_pool2d, max_pool2d
+from ...ops.upsample import interpolate_bilinear
+from ..common.blocks.dicl import DisplacementAwareProjection, MatchingNet
+from ..common.blocks.raft import ResidualBlock, kaiming_normal
+from ..common.corr.common import sample_window, stack_pair
+from ..common.encoders.raft import FeatureEncoderS3
+from ..common.grid import coordinate_grid
+from ..common.norm import Norm2d
+from ..common.util import identity_1x1_init
+from ..config import register_model
+from ..model import Model, ModelAdapter
+from .raft import BasicUpdateBlock, RaftAdapter, Up8Network, make_flow_regression
+
+
+class _OutputNet(nn.Module):
+    """Dilated 3x3 + 1x1 level head (reference raft_dicl_ml.py:18-32)."""
+
+    output_dim: int
+    dilation: int = 1
+    norm_type: str = "batch"
+
+    @nn.compact
+    def __call__(self, x, train=False, frozen_bn=False):
+        x = nn.Conv(128, (3, 3), kernel_dilation=self.dilation,
+                    kernel_init=kaiming_normal)(x)
+        x = Norm2d(self.norm_type, 8)(x, train and not frozen_bn)
+        x = nn.relu(x)
+        return nn.Conv(self.output_dim, (1, 1), kernel_init=kaiming_normal)(x)
+
+
+class StackEncoder(nn.Module):
+    """Frame-1 stack: all levels at 1/8, increasing dilation
+    (reference raft_dicl_ml.py:35-101)."""
+
+    output_dim: int
+    levels: int = 4
+    norm_type: str = "batch"
+
+    @nn.compact
+    def __call__(self, x, train=False, frozen_bn=False):
+        if not 1 <= self.levels <= 4:
+            raise ValueError("levels must be between 1 and 4 (inclusive)")
+
+        outs = [_OutputNet(self.output_dim, 1, self.norm_type)(x, train, frozen_bn)]
+        for lvl in range(1, self.levels):
+            x = ResidualBlock(256, self.norm_type, stride=1)(x, train, frozen_bn)
+            outs.append(_OutputNet(self.output_dim, 2 ** lvl, self.norm_type)(
+                x, train, frozen_bn))
+
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+class PyramidEncoder(nn.Module):
+    """Frame-2 pyramid: strided stages 384/576/864
+    (reference raft_dicl_ml.py:104-170)."""
+
+    output_dim: int
+    levels: int = 4
+    norm_type: str = "batch"
+
+    @nn.compact
+    def __call__(self, x, train=False, frozen_bn=False):
+        if not 1 <= self.levels <= 4:
+            raise ValueError("levels must be between 1 and 4 (inclusive)")
+
+        outs = [_OutputNet(self.output_dim, 1, self.norm_type)(x, train, frozen_bn)]
+        for channels in (384, 576, 864)[: self.levels - 1]:
+            x = ResidualBlock(channels, self.norm_type, stride=2)(x, train, frozen_bn)
+            outs.append(_OutputNet(self.output_dim, 1, self.norm_type)(
+                x, train, frozen_bn))
+
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+class MlCorrelationModule(nn.Module):
+    """Fused multi-level DICL lookup around one 1/8 flow estimate
+    (reference raft_dicl_ml.py:236-345)."""
+
+    feature_dim: int
+    levels: int
+    radius: int
+    dap_init: str = "identity"
+    dap_type: str = "separate"
+    norm_type: str = "batch"
+    share: bool = False
+
+    @nn.compact
+    def __call__(self, fmap1, fmap2, coords, dap=True, mask_costs=(),
+                 train=False, frozen_bn=False):
+        if self.dap_type not in ("full", "separate"):
+            raise ValueError(f"DAP type '{self.dap_type}' not supported")
+
+        b, h, w, _ = coords.shape
+        k = 2 * self.radius + 1
+
+        if self.share:
+            shared_mnet = MatchingNet(norm_type=self.norm_type)
+            mnets = [shared_mnet] * self.levels
+            if self.dap_type == "separate":
+                shared_dap = DisplacementAwareProjection(
+                    (self.radius, self.radius), init=self.dap_init)
+                daps = [shared_dap] * self.levels
+        else:
+            mnets = [MatchingNet(norm_type=self.norm_type)
+                     for _ in range(self.levels)]
+            if self.dap_type == "separate":
+                daps = [DisplacementAwareProjection(
+                            (self.radius, self.radius), init=self.dap_init)
+                        for _ in range(self.levels)]
+
+        out = []
+        for i, (f1, f2) in enumerate(zip(fmap1, fmap2)):
+            window = sample_window(f2, coords / 2 ** i, self.radius)
+            # the stack features stay at 1/8: broadcast f1 over the window
+            mvol = stack_pair(f1, window)
+
+            cost = mnets[i](mvol, train, frozen_bn)  # (B, H, W, du, dv)
+
+            if i + 3 in mask_costs:
+                cost = jnp.zeros_like(cost)
+
+            if dap and self.dap_type == "separate":
+                cost = daps[i](cost)
+
+            out.append(cost.reshape(b, h, w, k * k))
+
+        out = jnp.concatenate(out, axis=-1)
+
+        if self.dap_type == "full":
+            # always create the full-DAP params for config stability
+            full = nn.Conv(
+                self.levels * k * k, (1, 1), use_bias=False,
+                kernel_init=(identity_1x1_init if self.dap_init == "identity"
+                             else nn.initializers.lecun_normal()),
+            )
+            projected = full(out)
+            if dap:
+                out = projected
+
+        return out
+
+
+class RaftPlusDiclMlModule(nn.Module):
+    """RAFT+DICL multi-level network (reference raft_dicl_ml.py:350-470)."""
+
+    dropout: float = 0.0
+    mixed_precision: bool = False
+    corr_levels: int = 4
+    corr_radius: int = 4
+    corr_channels: int = 32
+    context_channels: int = 128
+    recurrent_channels: int = 128
+    dap_init: str = "identity"
+    dap_type: str = "separate"
+    encoder_norm: str = "instance"
+    context_norm: str = "batch"
+    mnet_norm: str = "batch"
+    encoder_type: str = "raft-cnn"
+    share_dicl: bool = False
+    corr_reg_type: str = "softargmax"
+    corr_reg_args: dict = None
+
+    @nn.compact
+    def __call__(self, img1, img2, train=False, frozen_bn=False, iterations=12,
+                 dap=True, upnet=True, corr_flow=False, corr_grad_stop=False,
+                 flow_init=None, mask_costs=()):
+        hdim = self.recurrent_channels
+        cdim = self.context_channels
+        dt = jnp.bfloat16 if self.mixed_precision else None
+
+        # asymmetric encoders (reference :173-236)
+        if self.encoder_type == "raft-cnn":
+            base = FeatureEncoderS3(output_dim=256, norm_type=self.encoder_norm,
+                                    dropout=0, dtype=dt)
+            b1, b2 = base((img1, img2), train, frozen_bn)
+            b1 = b1.astype(jnp.float32)
+            b2 = b2.astype(jnp.float32)
+
+            fmap1 = StackEncoder(self.corr_channels, self.corr_levels,
+                                 self.encoder_norm)(b1, train, frozen_bn)
+            fmap2 = PyramidEncoder(self.corr_channels, self.corr_levels,
+                                   self.encoder_norm)(b2, train, frozen_bn)
+            fmap1 = (fmap1,) if self.corr_levels == 1 else fmap1
+            fmap2 = (fmap2,) if self.corr_levels == 1 else fmap2
+        elif self.encoder_type in ("raft-avgpool", "raft-maxpool"):
+            pool = avg_pool2d if self.encoder_type.endswith("avgpool") else max_pool2d
+            base = FeatureEncoderS3(output_dim=self.corr_channels,
+                                    norm_type=self.encoder_norm, dropout=0,
+                                    dtype=dt)
+            f1, f2 = base((img1, img2), train, frozen_bn)
+            f1 = f1.astype(jnp.float32)
+            f2 = f2.astype(jnp.float32)
+
+            fmap1 = tuple([f1] * self.corr_levels)
+            pyramid = [f2]
+            for _ in range(1, self.corr_levels):
+                pyramid.append(pool(pyramid[-1], 2))
+            fmap2 = tuple(pyramid)
+        else:
+            raise ValueError(f"unknown encoder type: '{self.encoder_type}'")
+
+        cnet = FeatureEncoderS3(output_dim=hdim + cdim,
+                                norm_type=self.context_norm,
+                                dropout=self.dropout, dtype=dt)
+        ctx = cnet(img1, train, frozen_bn)
+        h = jnp.tanh(ctx[..., :hdim])
+        x = nn.relu(ctx[..., hdim:])
+
+        b, hc, wc, _ = fmap1[0].shape
+        coords0 = coordinate_grid(b, hc, wc)
+        coords1 = coords0 + flow_init if flow_init is not None else coords0
+
+        cvol = MlCorrelationModule(
+            feature_dim=self.corr_channels, levels=self.corr_levels,
+            radius=self.corr_radius, dap_init=self.dap_init,
+            dap_type=self.dap_type, norm_type=self.mnet_norm,
+            share=self.share_dicl,
+        )
+        reg = make_flow_regression(self.corr_reg_type, self.corr_levels,
+                                   self.corr_radius,
+                                   **(self.corr_reg_args or {}))
+        update = BasicUpdateBlock(hdim, dtype=dt)
+        upnet8 = Up8Network(dtype=dt)
+
+        out = []
+        out_corr = [[] for _ in range(self.corr_levels)]
+        for _ in range(iterations):
+            coords1 = jax.lax.stop_gradient(coords1)
+            flow = coords1 - coords0
+
+            corr = cvol(fmap1, fmap2, coords1, dap=dap, mask_costs=mask_costs,
+                        train=train, frozen_bn=frozen_bn)
+
+            readouts = reg(corr)
+            if corr_flow:
+                for i, delta in enumerate(readouts):
+                    out_corr[i].append(jax.lax.stop_gradient(flow) + delta)
+
+            if corr_grad_stop:
+                corr = jax.lax.stop_gradient(corr)
+
+            h, d = update(h, x, corr, flow)
+
+            coords1 = coords1 + d
+            flow = coords1 - coords0
+
+            flow_up = upnet8(h, flow)
+            if not upnet:
+                flow_up = 8.0 * interpolate_bilinear(
+                    flow, (img1.shape[1], img1.shape[2]))
+            out.append(flow_up)
+
+        if corr_flow:
+            return [*reversed(out_corr), out]  # coarse-to-fine, then final
+        return out
+
+
+@register_model
+class RaftPlusDiclMl(Model):
+    """``raft+dicl/ml`` (reference raft_dicl_ml.py:448-582)."""
+
+    type = "raft+dicl/ml"
+
+    @classmethod
+    def from_config(cls, cfg):
+        cls._typecheck(cfg)
+
+        p = cfg["parameters"]
+        return cls(
+            dropout=float(p.get("dropout", 0.0)),
+            mixed_precision=bool(p.get("mixed-precision", False)),
+            corr_levels=p.get("corr-levels", 4),
+            corr_radius=p.get("corr-radius", 4),
+            corr_channels=p.get("corr-channels", 32),
+            context_channels=p.get("context-channels", 128),
+            recurrent_channels=p.get("recurrent-channels", 128),
+            dap_init=p.get("dap-init", "identity"),
+            dap_type=p.get("dap-type", "separate"),
+            encoder_norm=p.get("encoder-norm", "instance"),
+            context_norm=p.get("context-norm", "batch"),
+            mnet_norm=p.get("mnet-norm", "batch"),
+            encoder_type=p.get("encoder-type", "raft-cnn"),
+            share_dicl=p.get("share-dicl", False),
+            corr_reg_type=p.get("corr-reg-type", "softargmax"),
+            corr_reg_args=p.get("corr-reg-args", {}),
+            arguments=cfg.get("arguments", {}),
+            on_stage_args=cfg.get("on-stage", {"freeze_batchnorm": True}),
+            on_epoch_args=cfg.get("on-epoch", {}),
+        )
+
+    def __init__(self, dropout=0.0, mixed_precision=False, corr_levels=4,
+                 corr_radius=4, corr_channels=32, context_channels=128,
+                 recurrent_channels=128, dap_init="identity",
+                 dap_type="separate", encoder_norm="instance",
+                 context_norm="batch", mnet_norm="batch",
+                 encoder_type="raft-cnn", share_dicl=False,
+                 corr_reg_type="softargmax", corr_reg_args={}, arguments={},
+                 on_epoch_args={}, on_stage_args={"freeze_batchnorm": True}):
+        self.dropout = dropout
+        self.mixed_precision = mixed_precision
+        self.corr_levels = corr_levels
+        self.corr_radius = corr_radius
+        self.corr_channels = corr_channels
+        self.context_channels = context_channels
+        self.recurrent_channels = recurrent_channels
+        self.dap_init = dap_init
+        self.dap_type = dap_type
+        self.encoder_norm = encoder_norm
+        self.context_norm = context_norm
+        self.mnet_norm = mnet_norm
+        self.encoder_type = encoder_type
+        self.share_dicl = share_dicl
+        self.corr_reg_type = corr_reg_type
+        self.corr_reg_args = dict(corr_reg_args)
+
+        super().__init__(
+            RaftPlusDiclMlModule(
+                dropout=dropout, mixed_precision=mixed_precision,
+                corr_levels=corr_levels, corr_radius=corr_radius,
+                corr_channels=corr_channels,
+                context_channels=context_channels,
+                recurrent_channels=recurrent_channels, dap_init=dap_init,
+                dap_type=dap_type, encoder_norm=encoder_norm,
+                context_norm=context_norm, mnet_norm=mnet_norm,
+                encoder_type=encoder_type, share_dicl=share_dicl,
+                corr_reg_type=corr_reg_type,
+                corr_reg_args=dict(corr_reg_args),
+            ),
+            arguments=arguments,
+            on_epoch_arguments=on_epoch_args,
+            on_stage_arguments=on_stage_args,
+        )
+
+    def get_config(self):
+        default_args = {
+            "iterations": 12,
+            "dap": True,
+            "upnet": True,
+            "corr_flow": False,
+            "corr_grad_stop": False,
+            "mask_costs": [],
+        }
+        return {
+            "type": self.type,
+            "parameters": {
+                "dropout": self.dropout,
+                "mixed-precision": self.mixed_precision,
+                "corr-levels": self.corr_levels,
+                "corr-radius": self.corr_radius,
+                "corr-channels": self.corr_channels,
+                "context-channels": self.context_channels,
+                "recurrent-channels": self.recurrent_channels,
+                "dap-init": self.dap_init,
+                "dap-type": self.dap_type,
+                "encoder-norm": self.encoder_norm,
+                "context-norm": self.context_norm,
+                "mnet-norm": self.mnet_norm,
+                "encoder-type": self.encoder_type,
+                "share-dicl": self.share_dicl,
+                "corr-reg-type": self.corr_reg_type,
+                "corr-reg-args": self.corr_reg_args,
+            },
+            "arguments": default_args | self.arguments,
+            "on-stage": {"freeze_batchnorm": True} | self.on_stage_arguments,
+            "on-epoch": dict(self.on_epoch_arguments),
+        }
+
+    def get_adapter(self) -> ModelAdapter:
+        return RaftAdapter(self)

@@ -61,12 +61,14 @@ class _Step(nn.Module):
                     frozen_bn=self.frozen_bn)
 
         # always call the readout so its params exist regardless of the
-        # static switch; XLA removes the unused branch
+        # static switch (a '+dap' readout has a trainable projection); XLA
+        # removes the unused branch
         reg = corr_mod.make_flow_regression(
             self.corr_type, self.corr_reg_type, self.corr_radius,
             **self.corr_reg_args,
         )
-        corr_flows = (flow + reg(corr),) if self.corr_flow else ()
+        readout = flow + reg(corr)
+        corr_flows = (readout,) if self.corr_flow else ()
 
         if self.corr_grad_stop:
             corr = jax.lax.stop_gradient(corr)

@@ -1,0 +1,222 @@
+"""RAFT "from scratch" variant: no materialized all-pairs volume.
+
+TPU-native (Flax, NHWC) implementation of the capabilities of reference
+src/models/impls/raft_fs.py:13-268: the second frame's features are
+avg-pooled into a pyramid and the correlation window is computed
+*on the fly* against each level via the framework's windowed-correlation
+op — O(B·H·W·K²·C) per lookup instead of the O(B·H²W²) volume. This is the
+framework's high-resolution memory story (SURVEY §5.7): the model of
+choice when the all-pairs volume does not fit HBM.
+
+The GRU loop is an ``nn.scan`` with rematerialization like the baseline.
+"""
+
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ...ops.corr import windowed_correlation
+from ...ops.pool import avg_pool2d
+from ...ops.upsample import interpolate_bilinear
+from ..common import encoders
+from ..common.grid import coordinate_grid
+from ..config import register_model
+from ..model import Model, ModelAdapter
+from .raft import BasicUpdateBlock, RaftAdapter, Up8Network
+
+
+class _FsStep(nn.Module):
+    """One GRU iteration — nn.scan body; carry is (hidden, coords1)."""
+
+    corr_levels: int
+    corr_radius: int
+    recurrent_channels: int
+    upnet: bool
+    mask_costs: Tuple[int, ...]
+    full_shape: Tuple[int, int]
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, carry, fmap1, pyramid, x, coords0):
+        h, coords1 = carry
+        coords1 = jax.lax.stop_gradient(coords1)
+        flow = coords1 - coords0
+
+        # on-the-fly windowed dot-product per pyramid level; the reference
+        # lookup skips the sqrt(C) normalization (raft_fs.py:76)
+        corr = []
+        for i, f2 in enumerate(pyramid):
+            level = windowed_correlation(
+                fmap1, f2, coords1, self.corr_radius, scale=float(2 ** i),
+                normalize=False,
+            )
+            if i + 3 in self.mask_costs:
+                level = jnp.zeros_like(level)
+            corr.append(level)
+        corr = jnp.concatenate(corr, axis=-1)
+
+        h, d = BasicUpdateBlock(self.recurrent_channels, dtype=self.dtype)(
+            h, x, corr, flow)
+
+        coords1 = coords1 + d
+        flow = coords1 - coords0
+
+        flow_up_net = Up8Network(dtype=self.dtype)(h, flow)
+        if self.upnet:
+            flow_up = flow_up_net
+        else:
+            flow_up = 8.0 * interpolate_bilinear(flow, self.full_shape)
+
+        return (h, coords1), flow_up
+
+
+class RaftFsModule(nn.Module):
+    """RAFT-fs network (reference RaftModule, raft_fs.py:92-170)."""
+
+    dropout: float = 0.0
+    mixed_precision: bool = False
+    corr_levels: int = 4
+    corr_radius: int = 4
+    corr_channels: int = 256
+    context_channels: int = 128
+    recurrent_channels: int = 128
+    encoder_norm: str = "instance"
+    context_norm: str = "batch"
+    remat: bool = True
+
+    @nn.compact
+    def __call__(self, img1, img2, train=False, frozen_bn=False,
+                 iterations=12, flow_init=None, upnet=True, mask_costs=()):
+        hdim = self.recurrent_channels
+        cdim = self.context_channels
+        dt = jnp.bfloat16 if self.mixed_precision else None
+
+        fnet = encoders.make_encoder_s3(
+            "raft", output_dim=self.corr_channels,
+            norm_type=self.encoder_norm, dropout=self.dropout, dtype=dt,
+        )
+        cnet = encoders.make_encoder_s3(
+            "raft", output_dim=hdim + cdim,
+            norm_type=self.context_norm, dropout=self.dropout, dtype=dt,
+        )
+
+        fmap1, fmap2 = fnet((img1, img2), train, frozen_bn)
+        fmap1 = fmap1.astype(jnp.float32)
+        fmap2 = fmap2.astype(jnp.float32)
+
+        # avg-pooled second-frame feature pyramid (raft_fs.py:26-31)
+        pyramid = [fmap2]
+        for _ in range(1, self.corr_levels):
+            pyramid.append(avg_pool2d(pyramid[-1], 2))
+
+        ctx = cnet(img1, train, frozen_bn)
+        h = jnp.tanh(ctx[..., :hdim])
+        x = nn.relu(ctx[..., hdim:])
+
+        b, hc, wc, _ = fmap1.shape
+        coords0 = coordinate_grid(b, hc, wc)
+        coords1 = coords0 + flow_init if flow_init is not None else coords0
+
+        body = nn.remat(_FsStep, prevent_cse=False) if self.remat else _FsStep
+        step = nn.scan(
+            body,
+            variable_broadcast="params",
+            split_rngs={"params": False, "dropout": True},
+            in_axes=nn.broadcast,
+            out_axes=0,
+            length=iterations,
+        )(
+            corr_levels=self.corr_levels,
+            corr_radius=self.corr_radius,
+            recurrent_channels=hdim,
+            upnet=upnet,
+            mask_costs=tuple(mask_costs),
+            full_shape=(img1.shape[1], img1.shape[2]),
+            dtype=dt,
+        )
+
+        (h, coords1), flows_up = step((h, coords1), fmap1, tuple(pyramid), x,
+                                      coords0)
+
+        return [flows_up[i] for i in range(iterations)]
+
+
+@register_model
+class RaftFs(Model):
+    """``raft/fs`` (reference raft_fs.py:173-268)."""
+
+    type = "raft/fs"
+
+    @classmethod
+    def from_config(cls, cfg):
+        cls._typecheck(cfg)
+
+        p = cfg["parameters"]
+        return cls(
+            dropout=float(p.get("dropout", 0.0)),
+            mixed_precision=bool(p.get("mixed-precision", False)),
+            corr_levels=p.get("corr-levels", 4),
+            corr_radius=p.get("corr-radius", 4),
+            corr_channels=p.get("corr-channels", 256),
+            context_channels=p.get("context-channels", 128),
+            recurrent_channels=p.get("recurrent-channels", 128),
+            encoder_norm=p.get("encoder-norm", "instance"),
+            context_norm=p.get("context-norm", "batch"),
+            arguments=cfg.get("arguments", {}),
+            on_stage_args=cfg.get("on-stage", {"freeze_batchnorm": True}),
+            on_epoch_args=cfg.get("on-epoch", {}),
+        )
+
+    def __init__(self, dropout=0.0, mixed_precision=False, corr_levels=4,
+                 corr_radius=4, corr_channels=256, context_channels=128,
+                 recurrent_channels=128, encoder_norm="instance",
+                 context_norm="batch", arguments={}, on_epoch_args={},
+                 on_stage_args={"freeze_batchnorm": True}):
+        self.dropout = dropout
+        self.mixed_precision = mixed_precision
+        self.corr_levels = corr_levels
+        self.corr_radius = corr_radius
+        self.corr_channels = corr_channels
+        self.context_channels = context_channels
+        self.recurrent_channels = recurrent_channels
+        self.encoder_norm = encoder_norm
+        self.context_norm = context_norm
+
+        super().__init__(
+            RaftFsModule(
+                dropout=dropout, mixed_precision=mixed_precision,
+                corr_levels=corr_levels, corr_radius=corr_radius,
+                corr_channels=corr_channels,
+                context_channels=context_channels,
+                recurrent_channels=recurrent_channels,
+                encoder_norm=encoder_norm, context_norm=context_norm,
+            ),
+            arguments=arguments,
+            on_epoch_arguments=on_epoch_args,
+            on_stage_arguments=on_stage_args,
+        )
+
+    def get_config(self):
+        default_args = {"iterations": 12, "upnet": True, "mask_costs": []}
+        return {
+            "type": self.type,
+            "parameters": {
+                "dropout": self.dropout,
+                "mixed-precision": self.mixed_precision,
+                "corr-levels": self.corr_levels,
+                "corr-radius": self.corr_radius,
+                "corr-channels": self.corr_channels,
+                "context-channels": self.context_channels,
+                "recurrent-channels": self.recurrent_channels,
+                "encoder-norm": self.encoder_norm,
+                "context-norm": self.context_norm,
+            },
+            "arguments": default_args | self.arguments,
+            "on-stage": {"freeze_batchnorm": True} | self.on_stage_arguments,
+            "on-epoch": dict(self.on_epoch_arguments),
+        }
+
+    def get_adapter(self) -> ModelAdapter:
+        return RaftAdapter(self)

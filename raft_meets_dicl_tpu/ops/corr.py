@@ -159,13 +159,18 @@ class CorrVolume:
         return lookup_pyramid(self.pyramid, coords, self.radius, mask_costs)
 
 
-def windowed_correlation(fmap1, fmap2_level, coords, radius, scale):
+def windowed_correlation(fmap1, fmap2_level, coords, radius, scale,
+                         normalize=True):
     """On-the-fly windowed correlation without materializing the volume.
 
     For each source position p with center c = coords[p]/scale, computes
     dot(f1[p], f2_level[c + d]) for d in the (2r+1)² window, with bilinear
     sampling of f2_level. Returns (B, H, W, (2r+1)²), channels (dx, dy)
     row-major. O(B·H·W·K²·C) memory instead of O(B·H²W²).
+
+    ``normalize`` divides by sqrt(C) like the RAFT baseline volume
+    (reference raft.py:33); the ``raft/fs`` variant's lookup skips it
+    (reference raft_fs.py:76).
     """
     from .sample import sample_bilinear
 
@@ -183,4 +188,6 @@ def windowed_correlation(fmap1, fmap2_level, coords, radius, scale):
     sampled = sampled.reshape(b, h, w, k * k, c)
 
     corr = jnp.einsum("bhwc,bhwkc->bhwk", fmap1, sampled, preferred_element_type=jnp.float32)
-    return corr / jnp.sqrt(jnp.asarray(c, dtype=jnp.float32))
+    if normalize:
+        corr = corr / jnp.sqrt(jnp.asarray(c, dtype=jnp.float32))
+    return corr

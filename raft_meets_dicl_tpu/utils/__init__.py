@@ -1,3 +1,4 @@
-from . import config, debug, expr, logging, model, seeds, vcs
+from . import config, debug, expr, logging, model, seeds, tfdata, vcs
 
-__all__ = ["config", "debug", "expr", "logging", "model", "seeds", "vcs"]
+__all__ = ["config", "debug", "expr", "logging", "model", "seeds", "tfdata",
+           "vcs"]

@@ -1,0 +1,64 @@
+"""TensorBoard event-file reading (reference src/utils/tfdata.py:25).
+
+Loads scalar series from event files written by the framework's
+SummaryWriter (or any TB writer) into pandas DataFrames.
+"""
+
+import numpy as np
+
+
+def _tensor_to_np(tensor):
+    from tensorboard.compat.proto import types_pb2
+
+    if tensor.dtype == types_pb2.DT_FLOAT:
+        values = np.array(tensor.float_val, dtype=np.single)
+    elif tensor.dtype == types_pb2.DT_DOUBLE:
+        values = np.array(tensor.double_val, dtype=np.double)
+    else:
+        raise NotImplementedError(f"unsupported tensor dtype {tensor.dtype}")
+
+    if len(tensor.tensor_shape.dim) == 0:
+        return values.item()
+
+    raise NotImplementedError("non-scalar tensors are not supported")
+
+
+def tfdata_scalars_to_pandas(file, tags=None):
+    """Scalar events of one TB event file → DataFrame(tag, step, time, value).
+
+    Handles both representations: migrated tensors with scalar data-class
+    metadata (what current writers emit) and legacy ``simple_value``.
+    """
+    # local imports: pandas/tensorboard are offline-analysis deps, not
+    # runtime deps of the package
+    import pandas as pd
+    from tensorboard.backend.event_processing.event_file_loader import (
+        EventFileLoader,
+    )
+    from tensorboard.compat.proto import summary_pb2
+
+    records = []
+    for event in EventFileLoader(str(file)).Load():
+        if not event.HasField("summary"):
+            continue
+
+        for value in event.summary.value:
+            if tags is not None and value.tag not in tags:
+                continue
+
+            if value.HasField("simple_value"):
+                scalar = value.simple_value
+            elif (value.metadata.data_class
+                  == summary_pb2.DataClass.DATA_CLASS_SCALAR):
+                scalar = _tensor_to_np(value.tensor)
+            else:
+                continue
+
+            records.append({
+                "tag": value.tag,
+                "step": event.step,
+                "time": event.wall_time,
+                "value": scalar,
+            })
+
+    return pd.DataFrame.from_records(records)

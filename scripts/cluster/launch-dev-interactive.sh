@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Open an interactive shell on worker 0 of a TPU pod/VM with the repo
+# on PYTHONPATH (reference scripts/cluster/launch-dev-interactive.sh).
+set -euo pipefail
+
+TPU_NAME="${TPU_NAME:?set TPU_NAME to the TPU pod/VM name}"
+ZONE="${ZONE:?set ZONE to the TPU zone}"
+REPO_DIR="${REPO_DIR:-\$HOME/raft_meets_dicl_tpu}"
+
+gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" --worker=0 \
+    -- -t "cd $REPO_DIR && PYTHONPATH=$REPO_DIR exec bash -l"

@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Prepare every host of a TPU pod slice: sync the repo and install deps.
+# (reference scripts/cluster/setup-env.sh, TPU edition)
+#
+# Usage: TPU_NAME=my-pod ZONE=us-central2-b ./scripts/cluster/setup-env.sh
+set -euo pipefail
+
+TPU_NAME="${TPU_NAME:?set TPU_NAME to the TPU pod/VM name}"
+ZONE="${ZONE:?set ZONE to the TPU zone}"
+REPO_DIR="${REPO_DIR:-\$HOME/raft_meets_dicl_tpu}"
+SRC_DIR="${SRC_DIR:-$(cd "$(dirname "$0")/../.." && pwd)}"
+
+# sync the framework to all workers
+gcloud compute tpus tpu-vm scp --recurse --zone "$ZONE" --worker=all \
+    "$SRC_DIR" "$TPU_NAME:$REPO_DIR"
+
+# install python dependencies (jax[tpu] ships with TPU VM images)
+gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" --worker=all \
+    --command "pip install --quiet flax optax chex einops opencv-python-headless pyyaml tqdm pandas matplotlib tensorboard"

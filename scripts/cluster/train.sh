@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Launch training on a TPU pod slice (all hosts).
+#
+# TPU-native equivalent of the reference's SLURM submission script
+# (reference scripts/cluster/train.sh:1-31): instead of sbatch + CUDA env
+# modules, this drives `gcloud compute tpus tpu-vm ssh --worker=all` so the
+# same SPMD program runs on every host of the slice. jax initializes the
+# distributed runtime from the TPU environment automatically; the data
+# mesh then spans all chips (ICI within the slice).
+#
+# Usage:
+#   TPU_NAME=my-pod ZONE=us-central2-b ./scripts/cluster/train.sh \
+#       -d cfg/strategy/baseline/raft/s0-chairs.yaml \
+#       -m cfg/model/raft-baseline.yaml
+set -euo pipefail
+
+TPU_NAME="${TPU_NAME:?set TPU_NAME to the TPU pod/VM name}"
+ZONE="${ZONE:?set ZONE to the TPU zone}"
+REPO_DIR="${REPO_DIR:-\$HOME/raft_meets_dicl_tpu}"
+
+gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" --worker=all \
+    --command "cd $REPO_DIR && python3 main.py train $*"

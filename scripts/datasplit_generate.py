@@ -1,0 +1,63 @@
+#!/usr/bin/env python3
+"""Generate dataset split files (values 0/1)
+(reference scripts/datasplit_generate.py:14-57)."""
+
+import argparse
+import sys
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+import raft_meets_dicl_tpu.data as data  # noqa: E402
+
+
+def main():
+    def fmtcls(prog):
+        return argparse.HelpFormatter(prog, max_help_position=42)
+
+    parser = argparse.ArgumentParser(
+        description="Generate split files (values: 0/1)",
+        formatter_class=fmtcls)
+    parser.add_argument("-d", "--data", required=True,
+                        help="the data source spec to generate the split for")
+    parser.add_argument("-o", "--output", required=True, help="output file")
+    parser.add_argument("-n", "--number", type=int, metavar="N",
+                        help="select exactly N elements at random")
+    parser.add_argument("-p", "--probability", type=float, metavar="P",
+                        help="select elements with probability P")
+    parser.add_argument("-k", "--key", metavar="K",
+                        help="select elements whose sample id contains K "
+                             "(comma-separated alternatives)")
+
+    args = parser.parse_args()
+
+    n_methods = sum(map(bool, (args.number, args.probability, args.key)))
+    if n_methods > 1:
+        raise ValueError("cannot set multiple methods at the same time")
+    if n_methods == 0:
+        raise ValueError("one of --number/--probability/--key must be set")
+
+    source = data.load(args.data)
+    n = len(source)
+
+    if args.number:
+        choices = np.random.choice(np.arange(n), args.number, replace=False)
+        split = np.zeros(n, dtype=bool)
+        split[choices] = True
+    elif args.probability:
+        split = np.random.rand(n) < args.probability
+    else:
+        keys = args.key.split(",")
+        split = [
+            any(k in str(m.sample_id) for k in keys for m in meta)
+            for _, _, _, _, meta in source
+        ]
+
+    with open(args.output, "w") as fd:
+        for x in split:
+            fd.write(f"{'1' if x else '0'}\n")
+
+
+if __name__ == "__main__":
+    main()

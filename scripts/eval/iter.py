@@ -1,0 +1,86 @@
+#!/usr/bin/env python3
+"""Evaluate checkpoints across recurrent iteration counts.
+
+Capability parity with reference scripts/eval/iter.py:18-50 with a
+config-driven matrix:
+
+```yaml
+output: itereval
+iterations: [1, 2, 4, 8, 12, 16, 24]
+models:
+  raft-baseline:
+    model: runs/<ts>/config.json
+    checkpoint: runs/<ts>/checkpoints/best.ckpt
+    data:
+      sintel-clean: cfg/data/mpi-sintel-clean.train-full.yaml
+```
+"""
+
+import argparse
+import json
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent.parent))
+
+from raft_meets_dicl_tpu import utils  # noqa: E402
+
+from multi import evaluate_one  # noqa: E402
+
+
+def main():
+    def fmtcls(prog):
+        return argparse.HelpFormatter(prog, max_help_position=42)
+
+    parser = argparse.ArgumentParser(
+        description="Evaluate across iteration counts", formatter_class=fmtcls)
+    parser.add_argument("-c", "--config", required=True,
+                        help="matrix specification (yaml/json)")
+    parser.add_argument("-o", "--output",
+                        help="output directory (overrides the spec)")
+
+    args = parser.parse_args()
+
+    spec = utils.config.load(args.config)
+    out_dir = Path(args.output or spec.get("output", "itereval"))
+    out_dir.mkdir(parents=True, exist_ok=True)
+    batch_size = int(spec.get("batch-size", 1))
+    iterations = spec["iterations"]
+
+    summary = {}
+    for model_name, model_spec in spec["models"].items():
+        model_cfg = utils.config.load(model_spec["model"])
+        if "strategy" in model_cfg:
+            model_cfg = model_cfg["model"]
+
+        for n_iter in iterations:
+            # bake the iteration count into the model arguments
+            cfg = dict(model_cfg)
+            cfg["model"] = dict(cfg["model"])
+            cfg["model"]["arguments"] = dict(
+                cfg["model"].get("arguments", {})) | {"iterations": n_iter}
+
+            with tempfile.NamedTemporaryFile(
+                    "w", suffix=".json", delete=False) as fd:
+                utils.config.store(fd.name, cfg)
+                tmp_model = fd.name
+
+            for data_name, data_cfg in model_spec["data"].items():
+                report = out_dir / f"{model_name}-i{n_iter}-{data_name}.json"
+                print(f"==> {model_name} / iterations={n_iter} / {data_name}")
+
+                evaluate_one(tmp_model, model_spec["checkpoint"], data_cfg,
+                             report, batch_size)
+
+                with open(report) as fd:
+                    result = json.load(fd)
+                summary[f"{model_name}/i{n_iter}/{data_name}"] = \
+                    result["summary"]
+
+    with open(out_dir / "summary.json", "w") as fd:
+        json.dump(summary, fd, indent=2)
+
+
+if __name__ == "__main__":
+    main()

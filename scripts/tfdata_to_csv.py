@@ -1,0 +1,55 @@
+#!/usr/bin/env python3
+"""Export TensorBoard scalar series to CSV
+(reference scripts/tfdata_to_csv.py)."""
+
+import argparse
+import sys
+from pathlib import Path
+
+import pandas as pd
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+from raft_meets_dicl_tpu.utils import tfdata  # noqa: E402
+
+
+def main():
+    def fmtcls(prog):
+        return argparse.HelpFormatter(prog, max_help_position=42)
+
+    parser = argparse.ArgumentParser(
+        description="Convert tensorboard scalar data to CSV",
+        formatter_class=fmtcls)
+    parser.add_argument("-d", "--data", required=True,
+                        help="the tensorboard log file")
+    parser.add_argument("-t", "--tag", required=True, action="append",
+                        help="the tag to export")
+    parser.add_argument("-o", "--output", required=True, action="append",
+                        help="output file")
+    parser.add_argument("-a", "--ewm", type=float,
+                        help="alpha for exponential weighted moving average")
+
+    args = parser.parse_args()
+
+    if len(args.output) != len(args.tag):
+        raise ValueError("must have one output file per tag")
+
+    print("loading data...")
+    df = tfdata.tfdata_scalars_to_pandas(args.data, args.tag)
+
+    print("converting...")
+    for output, tag in zip(args.output, args.tag):
+        out = pd.DataFrame()
+        out["step"] = df.loc[df.tag == tag].step
+        out["value"] = df.loc[df.tag == tag].value
+
+        if args.ewm is not None:
+            ewm = out["value"].ewm(alpha=args.ewm)
+            out["value"] = ewm.mean()
+            out["std"] = ewm.std().fillna(value=0.0)
+
+        print(f"writing CSV data to '{output}'")
+        out.to_csv(output, index=False)
+
+
+if __name__ == "__main__":
+    main()

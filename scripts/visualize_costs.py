@@ -1,0 +1,133 @@
+#!/usr/bin/env python3
+"""Dump correlation cost-volume visualizations as tiled images.
+
+Capability parity with reference scripts/visualize_costs.py:27-70+, jit
+edition: instead of registering torch forward hooks on the corr modules,
+the forward pass runs with flax ``capture_intermediates`` and every
+captured (B, H, W, du, dv) cost volume is rendered as a (dy·H, dx·W) tiled
+image through a matplotlib colormap.
+
+Usage:
+    ./scripts/visualize_costs.py -d data.yaml -m model.yaml -c chkpt.ckpt \
+        -o costs/ [--filter DisplacementAwareProjection]
+"""
+
+import argparse
+import sys
+from pathlib import Path
+
+import matplotlib
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+import raft_meets_dicl_tpu.data as data  # noqa: E402
+import raft_meets_dicl_tpu.models as models  # noqa: E402
+from raft_meets_dicl_tpu import strategy, utils  # noqa: E402
+
+UPSAMPLE = 4
+
+
+def save_cvol(cv, path, cmap="viridis"):
+    """cv: (H, W, du, dv) → tiled image with one (du, dv) block per pixel."""
+    import cv2
+
+    h, w, dx, dy = cv.shape
+    cv = np.transpose(cv, (3, 0, 2, 1))  # dy, h, dx, w
+    cv = np.transpose(cv, (1, 0, 3, 2))  # h, dy, w, dx
+
+    lo, hi = cv.min(), cv.max()
+    cv = (cv - lo) / max(hi - lo, 1e-12)
+
+    img = matplotlib.colormaps[cmap](cv)  # (h, dy, w, dx, 4)
+    img = np.repeat(np.repeat(img, UPSAMPLE, axis=1), UPSAMPLE, axis=3)
+    dyu, dxu = dy * UPSAMPLE, dx * UPSAMPLE
+
+    # spacing between pixels
+    framed = np.zeros((h, dyu + 1, w, dxu + 1, 4))
+    framed[:, :dyu, :, :dxu, :] = img
+    img = framed.reshape((dyu + 1) * h, (dxu + 1) * w, 4)[:-1, :-1]
+
+    bgra = (np.clip(img[..., [2, 1, 0, 3]], 0, 1) * 255).astype(np.uint8)
+    cv2.imwrite(str(path), bgra)
+
+
+def main():
+    def fmtcls(prog):
+        return argparse.HelpFormatter(prog, max_help_position=42)
+
+    parser = argparse.ArgumentParser(
+        description="Visualize correlation cost volumes", formatter_class=fmtcls)
+    parser.add_argument("-d", "--data", required=True, help="dataset spec")
+    parser.add_argument("-m", "--model", required=True, help="model spec")
+    parser.add_argument("-c", "--checkpoint", required=True, help="checkpoint")
+    parser.add_argument("-o", "--output", required=True, help="output directory")
+    parser.add_argument("--filter", default="",
+                        help="substring filter on captured module paths")
+    parser.add_argument("--limit", type=int, default=1,
+                        help="number of samples to visualize")
+    parser.add_argument("--cmap", default="viridis", help="colormap")
+
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    utils.logging.setup()
+
+    model_cfg = utils.config.load(args.model)
+    if "strategy" in model_cfg:
+        model_cfg = model_cfg["model"]
+    spec = models.load(model_cfg)
+    model, input = spec.model, spec.input
+
+    chkpt = strategy.Checkpoint.load(args.checkpoint)
+
+    dataset = data.load(args.data)
+    loader = input.apply(dataset).jax().loader(batch_size=1, shuffle=False)
+
+    img1, img2, *_ = loader.source[0]
+    variables = model.init(jax.random.PRNGKey(0), img1[:1], img2[:1])
+    variables, _, _ = chkpt.apply(variables=variables)
+
+    out_dir = Path(args.output)
+    out_dir.mkdir(parents=True, exist_ok=True)
+
+    @jax.jit
+    def capture(variables, img1, img2):
+        _, mutated = model.module.apply(
+            variables, img1, img2, train=False, frozen_bn=False,
+            capture_intermediates=True, mutable=["intermediates"],
+            **model.arguments,
+        )
+        return mutated["intermediates"]
+
+    from raft_meets_dicl_tpu.inspect.hooks import flatten_intermediates
+
+    for i, (img1, img2, flow, valid, meta) in enumerate(loader):
+        if i >= args.limit:
+            break
+
+        inter = jax.device_get(
+            capture(variables, jnp.asarray(img1), jnp.asarray(img2)))
+
+        n_saved = 0
+        for name, arr in flatten_intermediates(inter):
+            if args.filter and args.filter not in name:
+                continue
+            if arr.ndim != 5:  # cost volumes are (B, H, W, du, dv)
+                continue
+
+            sid = str(meta[0].sample_id).replace("/", "_")
+            path = out_dir / f"{sid}-{n_saved:03d}-{name.replace('.', '_')}.png"
+            save_cvol(np.asarray(arr[0]), path, args.cmap)
+            print(f"saved '{path}'")
+            n_saved += 1
+
+        if n_saved == 0:
+            print("no cost volumes captured — check --filter "
+                  "(cost volumes must be 5-D (B, H, W, du, dv))")
+
+
+if __name__ == "__main__":
+    main()

@@ -260,3 +260,23 @@ def test_gradient_anomaly_dumps_checkpoint(tmp_path):
     # the dump is a loadable checkpoint
     chkpt = strategy.Checkpoint.load(dumps[0])
     assert chkpt.model == "tiny"
+
+
+def test_tfdata_reads_back_writer_scalars(tmp_path):
+    """utils.tfdata round-trips scalars written by our SummaryWriter."""
+    from raft_meets_dicl_tpu.utils import tfdata
+
+    w = inspect_.SummaryWriter(tmp_path / "tb")
+    for step, value in enumerate((0.5, 0.25, 0.125)):
+        w.add_scalar("Loss", value, step)
+    w.add_scalar("Other", 1.0, 0)
+    w.close()
+
+    events = sorted((tmp_path / "tb").glob("events.out.tfevents.*"))
+    df = tfdata.tfdata_scalars_to_pandas(events[0])
+    loss = df[df.tag == "Loss"].sort_values("step")
+    assert list(loss.step) == [0, 1, 2]
+    assert list(loss.value) == [0.5, 0.25, 0.125]
+
+    filtered = tfdata.tfdata_scalars_to_pandas(events[0], tags={"Other"})
+    assert set(filtered.tag) == {"Other"}

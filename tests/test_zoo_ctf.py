@@ -1,0 +1,264 @@
+"""Model-zoo wave 2 tests: raft/sl, raft/fs, coarse-to-fine families,
+and the multi-level sequence losses."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import raft_meets_dicl_tpu.models as models
+from raft_meets_dicl_tpu.models.config import load_loss
+
+RNG = jax.random.PRNGKey(0)
+
+
+def _img(h=64, w=96, b=1, seed=0):
+    return jnp.asarray(np.random.RandomState(seed).rand(b, h, w, 3), jnp.float32)
+
+
+def test_registry_covers_wave2():
+    types = models.config.model_types()
+    for ty in ("raft/baseline", "raft/sl", "raft/fs", "raft/sl-ctf-l2",
+               "raft/sl-ctf-l3", "raft/sl-ctf-l4", "raft+dicl/sl",
+               "raft+dicl/ctf-l2", "raft+dicl/ctf-l3", "raft+dicl/ctf-l4",
+               "dicl/baseline", "dicl/64to8"):
+        assert ty in types, ty
+
+    losses = models.config.loss_types()
+    for ty in ("raft/sequence", "dicl/multiscale", "raft+dicl/mlseq",
+               "raft+dicl/mlseq-restricted"):
+        assert ty in losses, ty
+
+
+def test_raft_sl_forward():
+    m = models.config.load_model({
+        "type": "raft/sl",
+        "parameters": {"corr-radius": 2, "corr-channels": 16,
+                       "context-channels": 8, "recurrent-channels": 8},
+    })
+    img = _img()
+    v = jax.jit(lambda: m.init(RNG, img, img, iterations=1))()
+    out = jax.jit(lambda v: m.apply(v, img, img, iterations=2))(v)
+    assert len(out) == 2 and out[0].shape == (1, 64, 96, 2)
+    assert m.get_config()["type"] == "raft/sl"
+
+    cfg = m.get_config()
+    assert models.config.load_model(cfg).get_config() == cfg
+
+
+def test_raft_fs_forward():
+    m = models.config.load_model({
+        "type": "raft/fs",
+        "parameters": {"corr-levels": 3, "corr-radius": 2, "corr-channels": 16,
+                       "context-channels": 8, "recurrent-channels": 8},
+    })
+    img = _img()
+    v = jax.jit(lambda: m.init(RNG, img, img, iterations=1))()
+    out = jax.jit(lambda v: m.apply(v, img, img, iterations=2))(v)
+    assert len(out) == 2 and out[0].shape == (1, 64, 96, 2)
+
+    # mask_costs zeroes a level but keeps shapes
+    out = jax.jit(
+        lambda v: m.apply(v, img, img, iterations=1, mask_costs=(3,))
+    )(v)
+    assert out[0].shape == (1, 64, 96, 2)
+
+    cfg = m.get_config()
+    assert models.config.load_model(cfg).get_config() == cfg
+
+
+def test_raft_fs_matches_windowed_lookup_semantics():
+    """fs on-the-fly lookup == unnormalized dot product at grid coords."""
+    from raft_meets_dicl_tpu.ops.corr import windowed_correlation
+    from raft_meets_dicl_tpu.ops.warp import coordinate_grid
+
+    rs = np.random.RandomState(1)
+    f1 = jnp.asarray(rs.randn(1, 6, 8, 4), jnp.float32)
+    f2 = jnp.asarray(rs.randn(1, 6, 8, 4), jnp.float32)
+    coords = coordinate_grid(1, 6, 8)
+
+    corr = np.asarray(windowed_correlation(f1, f2, coords, 1, 1.0,
+                                           normalize=False))
+    y, x = 3, 4
+    for i, (dx, dy) in enumerate((dx, dy) for dx in (-1, 0, 1)
+                                 for dy in (-1, 0, 1)):
+        expect = float(np.dot(np.asarray(f1)[0, y, x],
+                              np.asarray(f2)[0, y + dy, x + dx]))
+        assert corr[0, y, x, i] == pytest.approx(expect, abs=1e-4)
+
+
+SL_CTF_PARAMS = {"corr-radius": 2, "corr-channels": 16, "context-channels": 8,
+                 "recurrent-channels": 8}
+
+
+@pytest.mark.parametrize("levels,ty,iters,size", [
+    (2, "raft/sl-ctf-l2", (2, 1), (64, 96)),
+    (3, "raft/sl-ctf-l3", (1, 1, 1), (64, 96)),
+])
+def test_raft_sl_ctf_forward(levels, ty, iters, size):
+    m = models.config.load_model({"type": ty, "parameters": SL_CTF_PARAMS})
+    h, w = size
+    img = _img(h, w)
+
+    v = jax.jit(lambda: m.init(RNG, img, img,
+                               iterations=tuple(1 for _ in range(levels))))()
+    out = jax.jit(lambda v: m.apply(v, img, img, iterations=iters))(v)
+
+    assert len(out) == levels  # coarse→fine level lists
+    assert [len(lv) for lv in out] == list(iters)
+    assert out[-1][-1].shape == (1, h, w, 2)  # finest is Up8-upsampled
+    coarsest = 2 ** (levels + 2)
+    assert out[0][0].shape == (1, h // coarsest, w // coarsest, 2)
+
+    res = m.get_adapter().wrap_result(out, (h, w))
+    assert res.final().shape == (1, h, w, 2)
+
+    loss = load_loss({"type": "raft+dicl/mlseq",
+                      "arguments": {"alpha": [0.4] * (levels - 1) + [1.0]}})
+    l = loss(m, res.output(), jnp.zeros((1, h, w, 2)),
+             jnp.ones((1, h, w), bool))
+    assert np.isfinite(float(l))
+
+    cfg = m.get_config()
+    assert models.config.load_model(cfg).get_config() == cfg
+
+
+CTF_PARAMS = {"corr-radius": 2, "corr-channels": 8, "context-channels": 8,
+              "recurrent-channels": 8, "corr-args": {"mnet_scale": 0.125}}
+
+
+def test_raft_dicl_ctf_l2_share_variants():
+    img = _img(64, 96)
+
+    for share_dicl, share_rnn in ((False, True), (True, False)):
+        m = models.config.load_model({
+            "type": "raft+dicl/ctf-l2",
+            "parameters": CTF_PARAMS | {"share-dicl": share_dicl,
+                                        "share-rnn": share_rnn,
+                                        "upsample-hidden": "bilinear"},
+        })
+        v = jax.jit(lambda m=m: m.init(RNG, img, img, iterations=(1, 1)))()
+        out = jax.jit(
+            lambda v, m=m: m.apply(v, img, img, iterations=(2, 1))
+        )(v)
+        assert [len(lv) for lv in out] == [2, 1]
+        assert out[-1][-1].shape == (1, 64, 96, 2)
+
+
+def test_raft_dicl_ctf_l3_flagship_with_restricted_loss():
+    m = models.config.load_model({
+        "type": "raft+dicl/ctf-l3",
+        "parameters": CTF_PARAMS | {"upsample-hidden": "bilinear"},
+    })
+    img = _img(128, 128)
+    target = jnp.zeros((1, 128, 128, 2))
+    valid = jnp.ones((1, 128, 128), bool)
+
+    v = jax.jit(lambda: m.init(RNG, img, img, iterations=(1, 1, 1)))()
+
+    @jax.jit
+    def fwd(v):
+        out = m.apply(v, img, img, iterations=(2, 1, 1), prev_flow=True)
+        res = m.get_adapter().wrap_result(out, (128, 128))
+        loss = load_loss({"type": "raft+dicl/mlseq-restricted",
+                          "arguments": {"alpha": [0.38, 0.6, 1.0],
+                                        "delta_range": [128, 64, 32]}})
+        return res.final(), loss(m, res.output(), target, valid)
+
+    final, l = fwd(v)
+    assert final.shape == (1, 128, 128, 2)
+    assert np.isfinite(float(l))
+
+    # prev_flow entries are (prev, flow) pairs; per-sample slicing keeps them
+    out = jax.jit(
+        lambda v: m.apply(v, img, img, iterations=(1, 1, 1), prev_flow=True)
+    )(v)
+    res = m.get_adapter().wrap_result(out, (128, 128))
+    sliced = res.output(0)
+    assert isinstance(sliced[0][0], list) and len(sliced[0][0]) == 2
+
+    cfg = m.get_config()
+    assert cfg["type"] == "raft+dicl/ctf-l3"
+    assert models.config.load_model(cfg).get_config() == cfg
+
+
+def test_raft_dicl_ctf_l3_corr_flow_output_structure():
+    m = models.config.load_model({
+        "type": "raft+dicl/ctf-l3",
+        "parameters": CTF_PARAMS,
+    })
+    img = _img(128, 128)
+    v = jax.jit(lambda: m.init(RNG, img, img, iterations=(1, 1, 1)))()
+
+    out = jax.jit(
+        lambda v: m.apply(v, img, img, iterations=(1, 1, 1), corr_flow=True)
+    )(v)
+    # per level: corr-readout list then flow list (reference :254-256)
+    assert len(out) == 6
+    res = m.get_adapter().wrap_result(out, (128, 128))
+    assert res.final().shape == (1, 128, 128, 2)
+
+
+def test_mlseq_loss_weighting():
+    """Level/iteration weighting matches the α·γ^(n-i-1) formula."""
+    loss = load_loss({"type": "raft+dicl/mlseq"})
+
+    target = jnp.zeros((1, 8, 8, 2))
+    valid = jnp.ones((1, 8, 8), bool)
+    one = jnp.ones((1, 8, 8, 2))  # unit flow → L1 dist = 2 everywhere
+
+    result = [[one], [one, one]]
+    # level 0: α=0.4, n=1 → 0.4·γ⁰·2 ; level 1: α=1.0 → (γ·2 + 2)
+    got = float(loss(None, result, target, valid,
+                     ord=1, gamma=0.5, alpha=(0.4, 1.0)))
+    expect = 0.4 * 2 + (0.5 * 2 + 2)
+    assert got == pytest.approx(expect, rel=1e-5)
+
+
+def test_raft_dicl_ml_forward():
+    img = _img()
+    for params in (
+        {"corr-levels": 2, "corr-radius": 2, "corr-channels": 8,
+         "context-channels": 8, "recurrent-channels": 8},
+        {"corr-levels": 2, "corr-radius": 2, "corr-channels": 8,
+         "context-channels": 8, "recurrent-channels": 8,
+         "encoder-type": "raft-maxpool", "dap-type": "full",
+         "share-dicl": True},
+    ):
+        m = models.config.load_model({"type": "raft+dicl/ml",
+                                      "parameters": params})
+        v = jax.jit(lambda m=m: m.init(RNG, img, img, iterations=1))()
+        out = jax.jit(lambda v, m=m: m.apply(v, img, img, iterations=2))(v)
+        assert len(out) == 2 and out[0].shape == (1, 64, 96, 2)
+
+        out = jax.jit(
+            lambda v, m=m: m.apply(v, img, img, iterations=1, corr_flow=True)
+        )(v)
+        assert len(out) == 3  # 2 corr levels (coarse→fine) + final sequence
+
+        res = m.get_adapter().wrap_result(out, (64, 96))
+        assert res.final().shape == (1, 64, 96, 2)
+
+        cfg = m.get_config()
+        assert models.config.load_model(cfg).get_config() == cfg
+
+
+def test_pool_and_rfpm_encoder_families():
+    from raft_meets_dicl_tpu.models.common import encoders
+
+    x = jnp.zeros((1, 64, 96, 3))
+    for fam in ("raft-avgpool", "raft-maxpool"):
+        enc = encoders.make_encoder_p34(fam, output_dim=16, norm_type="batch",
+                                        dropout=0)
+        outs = enc.apply(enc.init(RNG, x), x)
+        assert [o.shape[1] for o in outs] == [8, 4]
+
+    enc = encoders.make_encoder_s3("rfpm-raft", output_dim=16,
+                                   norm_type="batch", dropout=0)
+    out = enc.apply(enc.init(RNG, x), x)
+    assert out.shape == (1, 8, 12, 16)
+
+    enc = encoders.make_encoder_p34("rfpm-raft", output_dim=16,
+                                    norm_type="batch", dropout=0)
+    outs = enc.apply(enc.init(RNG, x), x)
+    assert [o.shape[1] for o in outs] == [8, 4]

@@ -1,0 +1,104 @@
+"""Kept-registered experiment models: raft/cl, raft+dicl/sl-ca, wip/warp/*."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import raft_meets_dicl_tpu.models as models
+from raft_meets_dicl_tpu.models.config import load_loss, load_model
+
+RNG = jax.random.PRNGKey(0)
+IMG = jnp.asarray(np.random.RandomState(0).rand(1, 128, 128, 3), jnp.float32)
+TARGET = jnp.zeros((1, 128, 128, 2))
+VALID = jnp.ones((1, 128, 128), bool)
+
+
+def test_registry_covers_full_zoo():
+    types = models.config.model_types()
+    assert len(types) == 17
+    for ty in ("raft/cl", "raft+dicl/sl-ca", "wip/warp/1", "wip/warp/2"):
+        assert ty in types, ty
+
+    losses = models.config.loss_types()
+    assert len(losses) == 10
+
+
+def test_raft_cl_with_corr_losses():
+    m = load_model({"type": "raft/cl", "parameters": {"corr-radius": 2}})
+    v = jax.jit(lambda: m.init(RNG, IMG, IMG, iterations=1))()
+
+    out = jax.jit(lambda v: m.apply(
+        v, IMG, IMG, iterations=2, corr_loss_examples=True,
+        rngs={"permute": jax.random.PRNGKey(1)},
+    ))(v)
+    assert sorted(out.keys()) == ["corr_neg", "corr_pos", "f1", "f2", "flow"]
+    assert len(out["flow"]) == 2 and out["flow"][0].shape == (1, 128, 128, 2)
+    assert len(out["f1"]) == 4  # 1/8 stack (lifted) per level
+
+    res = m.get_adapter().wrap_result(out, (128, 128))
+    assert res.final().shape == (1, 128, 128, 2)
+    sliced = res.output(0)
+    assert sliced["flow"][0].shape == (1, 128, 128, 2)
+
+    for lt in ("raft/cl/sequence", "raft/cl/sequence+corr_hinge",
+               "raft/cl/sequence+corr_mse"):
+        l = load_loss({"type": lt})(m, res.output(), TARGET, VALID)
+        assert np.isfinite(float(l)), lt
+
+    cfg = m.get_config()
+    assert load_model(cfg).get_config() == cfg
+
+
+def test_wip_warp_1_with_multiscale_losses():
+    m = load_model({"type": "wip/warp/1", "parameters": {"disp-range": [2, 2]}})
+    v = jax.jit(lambda: m.init(RNG, IMG, IMG))()
+
+    out = jax.jit(lambda v: m.apply(v, IMG, IMG, corr_loss_examples=True))(v)
+    assert len(out["flow"]) == 5  # one per level, finest (1/4) first
+    assert out["flow"][0].shape == (1, 32, 32, 2)
+
+    res = m.get_adapter().wrap_result(out, (128, 128))
+    assert res.final().shape == (1, 128, 128, 2)
+
+    weights = [1.0, 0.8, 0.6, 0.4, 0.2]
+    for lt in ("wip/warp/multiscale", "wip/warp/multiscale+corr_hinge",
+               "wip/warp/multiscale+corr_mse"):
+        l = load_loss({"type": lt})(m, res.output(), TARGET, VALID,
+                                    weights=weights)
+        assert np.isfinite(float(l)), lt
+
+    cfg = m.get_config()
+    assert load_model(cfg).get_config() == cfg
+
+
+def test_wip_warp_2_iterations():
+    m = load_model({"type": "wip/warp/2",
+                    "parameters": {"feature-channels": 8,
+                                   "disp-range": [[2, 2]] * 5}})
+    v = jax.jit(lambda: m.init(RNG, IMG, IMG))()
+
+    out = jax.jit(lambda v: m.apply(v, IMG, IMG, iterations=(1, 1, 1, 1, 2)))(v)
+    assert len(out) == 6  # total iterations across levels
+    assert out[-1].shape == (1, 32, 32, 2)  # finest level 1/4
+
+    res = m.get_adapter().wrap_result(out, (128, 128))
+    assert res.final().shape == (1, 128, 128, 2)
+
+    cfg = m.get_config()
+    assert load_model(cfg).get_config() == cfg
+
+
+def test_raft_dicl_sl_ca_forward():
+    m = load_model({
+        "type": "raft+dicl/sl-ca",
+        "parameters": {"corr-radius": 2, "corr-channels": 8,
+                       "context-channels": 8, "recurrent-channels": 8,
+                       "embedding-channels": 8},
+    })
+    img = jnp.asarray(np.random.RandomState(1).rand(1, 64, 96, 3), jnp.float32)
+    v = jax.jit(lambda: m.init(RNG, img, img, iterations=1))()
+    out = jax.jit(lambda v: m.apply(v, img, img, iterations=2))(v)
+    assert len(out) == 2 and out[0].shape == (1, 64, 96, 2)
+
+    cfg = m.get_config()
+    assert load_model(cfg).get_config() == cfg
