@@ -40,9 +40,11 @@ def main():
     spec = models.load({
         "name": "bench", "id": "bench",
         # mixed-precision bf16 is the TPU-native policy (the reference's
-        # autocast equivalent); profiling notes: XLA scalar gathers cost
-        # ~16ns/index on TPU, so the corr lookup is einsum-based (ops/corr),
-        # which took the step from 17s to ~0.67s at this config
+        # autocast equivalent). Profiling history at this config:
+        # - scalar-gather corr lookup: ~17 s/step; einsum lookup: 0.67 s
+        # - convex Up8 hoisted out of the remat'd scan (batched over
+        #   iterations, compact (s,k) mask layout): 0.45 s
+        # - remat policy saving the per-iteration corr lookups: 0.43 s
         "model": {"type": "raft/baseline", "parameters": {"mixed-precision": True}},
         "loss": {"type": "raft/sequence"},
         "input": None,

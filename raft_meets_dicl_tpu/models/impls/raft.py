@@ -156,7 +156,16 @@ class BasicUpdateBlock(nn.Module):
 
 
 class Up8Network(nn.Module):
-    """Convex 8x upsampling: per-pixel softmax over 3x3 coarse neighbors."""
+    """Convex 8x upsampling: per-pixel softmax over 3x3 coarse neighbors.
+
+    The contraction is shaped to keep intermediates compact: the softmax
+    weights stay (B, h, w, 64, 9) — subpixel-major, so the softmax reduces
+    over the trailing contiguous axis — and the neighbor sum produces
+    (B, h, w, 64, 2) with one pixel-shuffle transpose at the end. (A
+    direct 6-axis einsum to the interleaved layout makes XLA materialize
+    f32 (B, h, w, 9, 8, 8) tensors with layout copies — profiled as the
+    single largest cost of the training step.)
+    """
 
     temperature: float = 4.0  # 4.0 = 1.0/0.25 in original RAFT
     dtype: Any = None
@@ -165,15 +174,28 @@ class Up8Network(nn.Module):
     def __call__(self, hidden, flow):
         b, h, w, c = flow.shape
 
+        # mask channels are ordered (subpixel, neighbor) — the softmax then
+        # reduces over the *trailing, contiguous* axis (the reference's
+        # (neighbor, subpixel) order makes XLA transpose-copy the 37MB f32
+        # mask around the softmax; the torch-checkpoint importer permutes)
         mask = nn.Conv(256, (3, 3), dtype=self.dtype)(hidden)
         mask = nn.relu(mask)
         mask = nn.Conv(8 * 8 * 9, (1, 1), dtype=self.dtype)(mask)
-        mask = mask.reshape(b, h, w, 9, 8, 8).astype(jnp.float32)
-        mask = jax.nn.softmax(mask / self.temperature, axis=3)
+        mask = mask.reshape(b, h, w, 8 * 8, 9).astype(jnp.float32)
+        mask = jax.nn.softmax(mask / self.temperature, axis=-1)
 
         win = unfold3x3(8.0 * flow)  # (B, h, w, 9, 2)
 
-        up = jnp.einsum("bhwkij,bhwkc->bhiwjc", mask, win)
+        if self.dtype is not None:
+            # only the mask rides in reduced precision (convex weights in
+            # [0, 1], benign); the flow window stays f32 — it IS the model
+            # output, and bf16 ulp at 8·flow magnitudes is ~px-scale
+            mask = mask.astype(self.dtype)
+
+        up = jnp.einsum("bhwsk,bhwkc->bhwsc", mask, win,
+                        preferred_element_type=jnp.float32)
+        up = up.reshape(b, h, w, 8, 8, c)
+        up = up.transpose(0, 1, 3, 2, 4, 5)  # (B, h, 8, w, 8, C)
         return up.reshape(b, h * 8, w * 8, c)
 
 
@@ -182,19 +204,20 @@ class _RaftStep(nn.Module):
 
     Carry is (hidden, coords1); broadcast inputs are the correlation
     pyramid, context features, and the coords0 grid. Produces the
-    upsampled flow (and optional corr-flow readouts) per iteration.
+    coarse-grid flow and hidden state per iteration — the convex 8x
+    upsampling runs *outside* the scan, batched over all iterations (its
+    full-resolution intermediates would otherwise be rematerialized per
+    iteration in the backward pass; profiled as the step's largest cost).
     """
 
     corr_levels: int
     corr_radius: int
     recurrent_channels: int
-    upnet: bool
     corr_flow: bool
     corr_grad_stop: bool
     mask_costs: Tuple[int, ...]
     corr_reg_type: str
     corr_reg_args: dict
-    full_shape: Tuple[int, int]
     dtype: Any = None
 
     @nn.compact
@@ -204,6 +227,12 @@ class _RaftStep(nn.Module):
         flow = coords1 - coords0
 
         corr = lookup_pyramid(pyramid, coords1, self.corr_radius, self.mask_costs)
+        # named so the remat policy can save the lookup output: recomputing
+        # the windowed einsums in the backward pass costs more than the
+        # (B, H/8, W/8, L·(2r+1)²) buffer per iteration it saves
+        from jax.ad_checkpoint import checkpoint_name
+
+        corr = checkpoint_name(corr, "corr_features")
 
         # always *call* the readout so its params exist regardless of the
         # static switch (per-stage overrides / checkpoint compatibility);
@@ -225,14 +254,7 @@ class _RaftStep(nn.Module):
         coords1 = coords1 + d
         flow = coords1 - coords0
 
-        # same always-call rule for the upsampling network
-        flow_up_net = Up8Network(dtype=self.dtype)(h, flow)
-        if self.upnet:
-            flow_up = flow_up_net
-        else:
-            flow_up = 8.0 * upsample2d_bilinear(flow, self.full_shape)
-
-        return (h, coords1), (flow_up, corr_flows)
+        return (h, coords1), (flow, h, corr_flows)
 
 
 class RaftModule(nn.Module):
@@ -299,8 +321,17 @@ class RaftModule(nn.Module):
 
         # remat the scan body: recompute iteration activations in the
         # backward pass instead of storing 12 iterations' worth in HBM —
-        # this is what makes full-resolution training fit on one chip
-        body = nn.remat(_RaftStep, prevent_cse=False) if self.remat else _RaftStep
+        # this is what makes full-resolution training fit on one chip.
+        # The correlation lookups are exempted (saved): their einsums are
+        # the expensive part of the recompute and their outputs are small
+        if self.remat:
+            body = nn.remat(
+                _RaftStep, prevent_cse=False,
+                policy=jax.checkpoint_policies.save_only_these_names(
+                    "corr_features"),
+            )
+        else:
+            body = _RaftStep
         step = nn.scan(
             body,
             variable_broadcast="params",
@@ -312,19 +343,31 @@ class RaftModule(nn.Module):
             corr_levels=self.corr_levels,
             corr_radius=self.corr_radius,
             recurrent_channels=hdim,
-            upnet=upnet,
             corr_flow=corr_flow,
             corr_grad_stop=corr_grad_stop,
             mask_costs=tuple(mask_costs),
             corr_reg_type=self.corr_reg_type,
             corr_reg_args=reg_args,
-            full_shape=(img1.shape[1], img1.shape[2]),
             dtype=dt,
         )
 
-        (h, coords1), (flows_up, corr_flows) = step(
+        (h, coords1), (flows, hiddens, corr_flows) = step(
             (h, coords1), tuple(pyramid), x, coords0
         )
+
+        # convex 8x upsampling, batched over all iterations at once (one
+        # large einsum + pixel shuffle instead of 12 rematerialized ones);
+        # always *called* so its params exist regardless of ``upnet``
+        full_shape = (img1.shape[1], img1.shape[2])
+        flows_flat = flows.reshape(iterations * b, hc, wc, 2)
+        hiddens_flat = hiddens.reshape(iterations * b, hc, wc, hdim)
+
+        up_net = Up8Network(dtype=dt)(hiddens_flat, flows_flat)
+        if upnet:
+            flows_up = up_net
+        else:
+            flows_up = 8.0 * upsample2d_bilinear(flows_flat, full_shape)
+        flows_up = flows_up.reshape(iterations, b, *full_shape, 2)
 
         # unstack the scan axis into per-iteration lists (protocol parity)
         out = [flows_up[i] for i in range(iterations)]

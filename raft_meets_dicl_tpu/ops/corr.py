@@ -110,8 +110,17 @@ def _lookup_level(corr, x, y):
     wy = _interp_matrix(y, h2)  # (B, H1, W1, K, H2)
     wx = _interp_matrix(x, w2)  # (B, H1, W1, K, W2)
 
+    if corr.dtype == jnp.bfloat16:
+        # under the bf16 policy the interpolation weights ride the MXU in
+        # bf16 too (halves the dominant HBM read); hat weights are in [0, 1]
+        # so the rounding error is benign, and accumulation stays f32
+        wy = wy.astype(jnp.bfloat16)
+        wx = wx.astype(jnp.bfloat16)
+
     t = jnp.einsum("bijkh,bijhw->bijkw", wy, corr,
                    preferred_element_type=jnp.float32)
+    if corr.dtype == jnp.bfloat16:
+        t = t.astype(jnp.bfloat16)
     return jnp.einsum("bijaw,bijkw->bijak", wx, t,
                       preferred_element_type=jnp.float32)
 

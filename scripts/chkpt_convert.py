@@ -105,9 +105,9 @@ def _raft_rules():
     rules[f"{head}.Conv_0"] = "update_block.flow_head.conv1"
     rules[f"{head}.Conv_1"] = "update_block.flow_head.conv2"
 
-    up = f"{step}.Up8Network_0"
-    rules[f"{up}.Conv_0"] = "update_block.mask.0"
-    rules[f"{up}.Conv_1"] = "update_block.mask.2"
+    # the upsampling network lives outside the scan (batched application)
+    rules["Up8Network_0.Conv_0"] = "update_block.mask.0"
+    rules["Up8Network_0.Conv_1"] = "update_block.mask.2"
 
     return rules
 
@@ -166,6 +166,16 @@ def _fill_variables(variables, torch_state, rules):
     return filled, unused
 
 
+def _permute_mask_head(filled):
+    """The flax Up8 mask head orders its 576 output channels
+    (subpixel, neighbor) — torch RAFT orders them (neighbor, subpixel);
+    permute so the imported weights read out identically."""
+    perm = np.argsort([s * 9 + k for k in range(9) for s in range(64)])
+    head = filled["params"]["Up8Network_0"]["Conv_1"]
+    head["kernel"] = head["kernel"][..., perm]
+    head["bias"] = head["bias"][perm]
+
+
 def convert_raft(torch_state, metadata):
     """princeton-vl RAFT (or reference raft/baseline) → ``raft/baseline``."""
     import jax
@@ -185,6 +195,8 @@ def convert_raft(torch_state, metadata):
     filled, unused = _fill_variables(variables, state, _raft_rules())
     if unused:
         logging.warning(f"unused torch keys: {sorted(unused)}")
+
+    _permute_mask_head(filled)
 
     from flax import serialization
 

@@ -25,6 +25,11 @@ def _fabricate_torch_state(variables):
     rules = chkpt_convert._raft_rules()
     state = {}
 
+    # inverse of the converter's mask-head channel permutation: flax orders
+    # the 576 channels (subpixel, neighbor), torch (neighbor, subpixel)
+    to_torch_order = np.asarray(
+        [s * 9 + k for k in range(9) for s in range(64)])
+
     for name, leaf in tree_named_leaves(variables):
         col, *path = name.split(".")
         module_path = ".".join(path[:-1])
@@ -32,6 +37,9 @@ def _fabricate_torch_state(variables):
         torch_mod = rules[module_path]
 
         value = np.asarray(leaf)
+        if module_path == "Up8Network_0.Conv_1":
+            value = (value[..., to_torch_order] if leaf_name == "kernel"
+                     else value[to_torch_order])
         if col == "params":
             if leaf_name == "kernel":
                 key = f"{torch_mod}.weight"
@@ -65,6 +73,7 @@ def test_raft_conversion_roundtrip(tmp_path):
     filled, unused = chkpt_convert._fill_variables(
         variables, state, chkpt_convert._raft_rules())
     assert not unused, f"unmapped torch keys: {sorted(unused)[:5]}"
+    chkpt_convert._permute_mask_head(filled)
 
     # lossless: every leaf returns bit-identical
     orig = dict(tree_named_leaves(variables))
@@ -107,3 +116,34 @@ def test_raft_conversion_end_to_end(tmp_path):
     )(restored)
     assert flows[-1].shape == (1, 64, 96, 2)
     assert bool(jnp.all(jnp.isfinite(flows[-1])))
+
+
+def test_mask_head_permutation_matches_golden_op():
+    """The (subpixel, neighbor) mask layout + converter permutation must
+    reproduce the torch-ordered convex upsampling exactly — checked against
+    the torch-parity-tested op (ops.convex_upsample_8x), which consumes
+    (neighbor, subpixel)-ordered logits."""
+    import jax.nn
+
+    from raft_meets_dicl_tpu.models.common.util import unfold3x3
+    from raft_meets_dicl_tpu.ops.upsample import convex_upsample_8x
+
+    rs = np.random.RandomState(11)
+    b, h, w = 2, 6, 8
+    logits_t = jnp.asarray(rs.randn(b, h, w, 9 * 64), jnp.float32)  # (k, s)
+    flow = jnp.asarray(rs.randn(b, h, w, 2), jnp.float32)
+
+    expected = convex_upsample_8x(flow, logits_t, temperature=4.0)
+
+    # converter-permuted logits, evaluated with the Up8Network math
+    perm = np.argsort([s * 9 + k for k in range(9) for s in range(64)])
+    logits_f = logits_t[..., perm]
+
+    mask = logits_f.reshape(b, h, w, 64, 9)
+    mask = jax.nn.softmax(mask / 4.0, axis=-1)
+    win = unfold3x3(8.0 * flow)
+    up = jnp.einsum("bhwsk,bhwkc->bhwsc", mask, win)
+    up = up.reshape(b, h, w, 8, 8, 2).transpose(0, 1, 3, 2, 4, 5)
+    actual = up.reshape(b, h * 8, w * 8, 2)
+
+    assert np.allclose(np.asarray(actual), np.asarray(expected), atol=1e-5)
