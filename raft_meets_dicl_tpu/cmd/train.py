@@ -253,7 +253,19 @@ def _train(args):
         log.warn("anomaly detection enabled")
         jax.config.update("jax_debug_nans", True)
 
-    tctx.run(args.start_stage, args.start_epoch, chkpt)
+    # §5.1 tracing: device-level profile of the (typically --limit-steps
+    # bounded) run — the TPU analog of the reference's torch-tb-profiler
+    # dev dependency
+    profile_dir = getattr(args, "profile", None)
+    if profile_dir:
+        log.info(f"capturing jax.profiler trace to '{profile_dir}'")
+        jax.profiler.start_trace(profile_dir)
+
+    try:
+        tctx.run(args.start_stage, args.start_epoch, chkpt)
+    finally:
+        if profile_dir:
+            jax.profiler.stop_trace()
 
 
 def train(args):

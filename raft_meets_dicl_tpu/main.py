@@ -66,6 +66,10 @@ def main():
     train.add_argument("--comment", dest="comment", help="comment to add to config file")
     train.add_argument("--limit-steps", type=int, dest="steps",
                        help="limit to a fixed number of steps")
+    train.add_argument("--profile", metavar="DIR",
+                       help="capture a jax.profiler trace of the run into DIR "
+                            "(open with TensorBoard's profile plugin); "
+                            "combine with --limit-steps")
 
     # subcommand: evaluate
     eval_ = subp.add_parser("evaluate", aliases=["e", "eval"], formatter_class=fmtcls,

@@ -167,3 +167,46 @@ def test_model_config_roundtrip():
     spec2 = models.load(cfg)
     assert spec2.model.corr_levels == 3
     assert cfg["model"]["arguments"]["iterations"] == 2
+
+
+@pytest.mark.parametrize("ord,include_invalid", [
+    (1, False), (2, False), ("absmean", False),
+    (1, True), ("absmean", True),
+])
+def test_sequence_loss_matches_torch_semantics(ord, include_invalid):
+    """Torch-golden check of the documented reference semantics
+    (src/models/impls/raft.py:616-644): L-ord / absmean distance, valid
+    pixels either masked out of the mean or zeroed into it."""
+    import torch
+
+    rs = np.random.RandomState(5)
+    n, b, h, w = 3, 2, 8, 10
+    flows = [rs.randn(b, h, w, 2).astype(np.float32) for _ in range(n)]
+    target = rs.randn(b, h, w, 2).astype(np.float32)
+    valid = rs.rand(b, h, w) > 0.3
+    gamma = 0.8
+
+    # torch reference, NCHW like the original
+    t_target = torch.from_numpy(target.transpose(0, 3, 1, 2))
+    t_valid = torch.from_numpy(valid)
+    expected = 0.0
+    for i, f in enumerate(flows):
+        t_flow = torch.from_numpy(f.transpose(0, 3, 1, 2))
+        weight = gamma ** (n - i - 1)
+        if ord == "absmean":
+            dist = (t_flow - t_target).abs().mean(dim=-3)
+        else:
+            dist = torch.linalg.vector_norm(t_flow - t_target, ord=ord, dim=-3)
+        if include_invalid:
+            dist = dist * t_valid
+            expected = expected + weight * dist.mean()
+        else:
+            expected = expected + weight * dist[t_valid].mean()
+    expected = float(expected)
+
+    loss = raft_impl.SequenceLoss()
+    got = float(loss(None, [jnp.asarray(f) for f in flows],
+                     jnp.asarray(target), jnp.asarray(valid),
+                     ord=ord, gamma=gamma, include_invalid=include_invalid))
+
+    assert got == pytest.approx(expected, rel=1e-5)
