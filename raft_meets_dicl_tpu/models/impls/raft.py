@@ -362,7 +362,13 @@ class RaftModule(nn.Module):
         flows_flat = flows.reshape(iterations * b, hc, wc, 2)
         hiddens_flat = hiddens.reshape(iterations * b, hc, wc, hdim)
 
-        up_net = Up8Network(dtype=dt)(hiddens_flat, flows_flat)
+        # remat'd: recomputing the two convs + softmax in the backward pass
+        # is cheaper than saving the f32 mask residuals (66MB with layout
+        # copies at the bench config)
+        # explicit name: the remat wrapper would otherwise prefix the module
+        # path ('CheckpointUp8Network_0'), breaking checkpoint compatibility
+        up_net = nn.remat(Up8Network, prevent_cse=False)(
+            dtype=dt, name="Up8Network_0")(hiddens_flat, flows_flat)
         if upnet:
             flows_up = up_net
         else:
