@@ -61,6 +61,14 @@ def _conv(torch_w):
     return np.transpose(torch_w, (2, 3, 1, 0))
 
 
+def _conv_t(torch_w):
+    """torch ConvTranspose2d weight (I, O, kh, kw) → flax ConvTranspose
+    kernel (kh, kw, I, O) with ``transpose_kernel=False`` semantics —
+    spatial flip + axis moves (verified bit-exact in f64 against
+    ``F.conv_transpose2d`` k4/s2/p1 vs flax 'SAME')."""
+    return np.transpose(torch_w[:, :, ::-1, ::-1], (2, 3, 0, 1))
+
+
 def _stem_rules(src):
     """flax _Stem path fragment → torch fnet/cnet path fragment."""
     rules = {
@@ -142,7 +150,9 @@ def _fill_variables(variables, torch_state, rules):
         if col == "params":
             if leaf_name == "kernel":
                 src = f"{torch_mod}.weight"
-                value = _conv(torch_state[src])
+                transform = (_conv_t if path[-2].startswith("ConvTranspose")
+                             else _conv)
+                value = transform(torch_state[src])
             elif leaf_name == "bias":
                 src = f"{torch_mod}.bias"
                 value = torch_state[src]
@@ -212,8 +222,145 @@ def convert_raft(torch_state, metadata):
     )
 
 
+# jytime/DICL-Flow naming → canonical prefixes (the same renames the
+# reference applies, chkpt_convert.py:54-90, minus its torch-side block
+# internals which the flax rules below absorb)
+_DICL_PFX = [
+    ("module.", ""),
+    ("feature.conv_start.", "feature.conv0."),
+] + [
+    (f"dap_layer{x}.dap_layer.conv.", f"dap{x}.") for x in range(2, 7)
+]
+
+
+def _dicl_block_rules(flax_path, torch_block, transposed=False, bias=False):
+    """Leaf rules for one ConvBlock-style block (jytime blocks name their
+    children .conv / .bn; plain final convs carry weight+bias directly)."""
+    if bias:
+        return {f"{flax_path}.Conv_0": torch_block}
+
+    conv_child = "ConvTranspose_0" if transposed else "Conv_0"
+    return {
+        f"{flax_path}.{conv_child}": f"{torch_block}.conv",
+        f"{flax_path}.Norm2d_0.BatchNorm_0": f"{torch_block}.bn",
+    }
+
+
+def _dicl_rules():
+    """flax module path → canonical torch path for ``dicl/baseline``.
+
+    The GA-Net hourglass is parametric here (one FeatureEncoderGa) while
+    jytime unrolls it — creation order fixes the suffix correspondence:
+    stem ConvBlock_0..2, down ladder ConvBlock_3..8 = conv1a..6a, first up
+    ladder GaT_0..5 = deconv6a..1a, second down GaConv_0..5 = conv1b..6b,
+    final up GaT_6..10 = deconv6b..2b with heads ConvBlock_9..13 =
+    outconv6..2. FlowLevel_0..4 = lvl6..lvl2 (coarse→fine creation).
+    """
+    enc = "FeatureEncoderGa_0"
+    rules = {}
+
+    for i in range(3):  # stem
+        rules |= _dicl_block_rules(f"{enc}.ConvBlock_{i}", f"feature.conv0.{i}")
+
+    for i in range(1, 7):  # first down ladder
+        rules |= _dicl_block_rules(f"{enc}.ConvBlock_{i + 2}",
+                                   f"feature.conv{i}a")
+
+    def ga_rules(flax_path, torch_block, transposed):
+        first = "ConvTranspose_0" if transposed else "Conv_0"
+        second = "Conv_0" if transposed else "Conv_1"
+        return {
+            f"{flax_path}.{first}": f"{torch_block}.conv1.conv",
+            f"{flax_path}.{second}": f"{torch_block}.conv2.conv",
+            f"{flax_path}.Norm2d_0.BatchNorm_0": f"{torch_block}.conv2.bn",
+        }
+
+    for n, i in enumerate(range(6, 0, -1)):  # first up ladder
+        rules |= ga_rules(f"{enc}.GaConv2xBlockTransposed_{n}",
+                          f"feature.deconv{i}a", True)
+
+    for i in range(1, 7):  # second down ladder
+        rules |= ga_rules(f"{enc}.GaConv2xBlock_{i - 1}",
+                          f"feature.conv{i}b", False)
+
+    for n, i in enumerate(range(6, 1, -1)):  # final up ladder + heads
+        rules |= ga_rules(f"{enc}.GaConv2xBlockTransposed_{n + 6}",
+                          f"feature.deconv{i}b", True)
+        rules |= _dicl_block_rules(f"{enc}.ConvBlock_{n + 9}",
+                                   f"feature.outconv{i}")
+
+    # flow levels, coarse→fine: FlowLevel_0 = lvl 6 ... FlowLevel_4 = lvl 2
+    ctx_layers = {6: 3, 5: 4, 4: 5, 3: 6, 2: 6}
+    for idx, lvl in enumerate(range(6, 1, -1)):
+        fl = f"FlowLevel_{idx}"
+        mnet = f"matching{lvl}.match"
+
+        for i in range(4):
+            rules |= _dicl_block_rules(f"{fl}.MatchingNet_0.ConvBlock_{i}",
+                                       f"{mnet}.{i}")
+        rules |= _dicl_block_rules(f"{fl}.MatchingNet_0.ConvBlockTransposed_0",
+                                   f"{mnet}.4", transposed=True)
+        rules |= _dicl_block_rules(f"{fl}.MatchingNet_0", f"{mnet}.5",
+                                   bias=True)
+
+        rules[f"{fl}.DisplacementAwareProjection_0.Conv_0"] = f"dap{lvl}"
+
+        n_ctx = ctx_layers[lvl]
+        for i in range(n_ctx):
+            rules |= _dicl_block_rules(f"{fl}.CtfContextNet_0.ConvBlock_{i}",
+                                       f"context_net{lvl}.{i}")
+        rules |= _dicl_block_rules(f"{fl}.CtfContextNet_0",
+                                   f"context_net{lvl}.{n_ctx}", bias=True)
+
+    return rules
+
+
+def convert_dicl(torch_state, metadata):
+    """jytime/DICL-Flow checkpoint → ``dicl/baseline``."""
+    import jax
+    import jax.numpy as jnp
+
+    state = _normalize(torch_state, _DICL_PFX)
+
+    spec = models.load({
+        "name": "DICL baseline", "id": "dicl/baseline",
+        "model": {
+            "type": "dicl/baseline",
+            "parameters": {
+                "displacement-range": {
+                    f"level-{lvl}": [3, 3] for lvl in range(2, 7)
+                },
+            },
+        },
+        "loss": {"type": "dicl/multiscale",
+                 "arguments": {"weights": [1.0] * 10}},
+        "input": {"padding": {"type": "modulo", "mode": "zeros",
+                              "size": [128, 128]}},
+    })
+    img = jnp.zeros((1, 128, 128, 3), jnp.float32)
+    variables = spec.model.init(jax.random.PRNGKey(0), img, img)
+
+    filled, unused = _fill_variables(variables, state, _dicl_rules())
+    if unused:
+        logging.warning(f"unused torch keys: {sorted(unused)}")
+
+    from flax import serialization
+
+    return Checkpoint(
+        model="dicl/baseline",
+        iteration=Iteration(0, 0, 0),
+        metrics={},
+        state=State(
+            model=serialization.to_state_dict(filled),
+            optimizer=None, scaler=None, lr_sched_inst=[], lr_sched_epoch=[],
+        ),
+        metadata=metadata,
+    )
+
+
 CONVERTERS = {
     "raft": convert_raft,
+    "dicl": convert_dicl,
 }
 
 

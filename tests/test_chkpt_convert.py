@@ -147,3 +147,99 @@ def test_mask_head_permutation_matches_golden_op():
     actual = up.reshape(b, h * 8, w * 8, 2)
 
     assert np.allclose(np.asarray(actual), np.asarray(expected), atol=1e-5)
+
+
+def test_dicl_conversion_roundtrip():
+    """The dicl/baseline mapping must cover the whole tree losslessly for
+    jytime-style state dicts (incl. the ConvTranspose flip transform)."""
+    import torch
+
+    spec = models.load({
+        "name": "DICL baseline", "id": "dicl/baseline",
+        "model": {
+            "type": "dicl/baseline",
+            "parameters": {
+                "displacement-range": {f"level-{l}": [3, 3]
+                                       for l in range(2, 7)},
+            },
+        },
+        "loss": {"type": "dicl/multiscale", "arguments": {"weights": [1.0] * 10}},
+        "input": None,
+    })
+    img = jnp.zeros((1, 128, 128, 3), jnp.float32)
+    variables = spec.model.init(jax.random.PRNGKey(2), img, img)
+
+    rules = chkpt_convert._dicl_rules()
+
+    # fabricate a jytime-style torch state dict (inverse transforms)
+    state = {}
+    for name, leaf in tree_named_leaves(variables):
+        col, *path = name.split(".")
+        module_path = ".".join(path[:-1])
+        leaf_name = path[-1]
+        torch_mod = rules[module_path]
+
+        value = np.asarray(leaf)
+        if col == "params":
+            if leaf_name == "kernel":
+                key = f"{torch_mod}.weight"
+                if path[-2].startswith("ConvTranspose"):
+                    # inverse of _conv_t: HWIO → IOHW, then spatial flip
+                    value = np.transpose(value, (2, 3, 0, 1))[:, :, ::-1, ::-1]
+                else:
+                    value = np.transpose(value, (3, 2, 0, 1))
+            elif leaf_name == "bias":
+                key = f"{torch_mod}.bias"
+            else:
+                key = f"{torch_mod}.weight"
+        else:
+            key = (f"{torch_mod}.running_mean" if leaf_name == "mean"
+                   else f"{torch_mod}.running_var")
+        state[key] = torch.from_numpy(value.copy())
+
+    # back through jytime naming, then through the converter
+    jytime = {}
+    for k, v in state.items():
+        k = k.replace("feature.conv0.", "feature.conv_start.")
+        for x in range(2, 7):
+            k = k.replace(f"dap{x}.", f"dap_layer{x}.dap_layer.conv.")
+        jytime[f"module.{k}"] = v
+
+    norm = chkpt_convert._normalize(jytime, chkpt_convert._DICL_PFX)
+    filled, unused = chkpt_convert._fill_variables(variables, norm, rules)
+    assert not unused, f"unmapped torch keys: {sorted(unused)[:5]}"
+
+    orig = dict(tree_named_leaves(variables))
+    conv = dict(tree_named_leaves(filled))
+    assert orig.keys() == conv.keys()
+    for k in orig:
+        assert np.array_equal(np.asarray(orig[k]), conv[k]), k
+
+
+def test_conv_transpose_import_transform_matches_torch():
+    """_conv_t must make flax ConvTranspose (SAME, unflipped kernel)
+    reproduce torch ConvTranspose2d(k4, s2, p1) bit-for-bit in f64."""
+    import torch
+    from flax import linen as fnn
+
+    rs = np.random.RandomState(4)
+    x = rs.randn(1, 4, 6, 3)
+    wt = rs.randn(3, 5, 4, 4)  # torch (I, O, kh, kw)
+
+    expected = torch.nn.functional.conv_transpose2d(
+        torch.from_numpy(x.transpose(0, 3, 1, 2)), torch.from_numpy(wt),
+        stride=2, padding=1,
+    ).numpy().transpose(0, 2, 3, 1)
+
+    prior_x64 = jax.config.jax_enable_x64
+    jax.config.update("jax_enable_x64", True)
+    try:
+        mod = fnn.ConvTranspose(5, (4, 4), strides=(2, 2), padding="SAME",
+                                use_bias=False)
+        out = np.asarray(mod.apply(
+            {"params": {"kernel": jnp.asarray(chkpt_convert._conv_t(wt))}},
+            jnp.asarray(x)))
+    finally:
+        jax.config.update("jax_enable_x64", prior_x64)
+
+    assert np.abs(out - expected).max() < 1e-10
