@@ -1,0 +1,158 @@
+"""CLI end-to-end: train → checkpoint info → evaluate → gencfg → retrain.
+
+Drives ./main.py the way a user does, over a synthesized Sintel-like tree
+(the reference framework's primary interface, src/main.py:34-117).
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).parent.parent
+
+
+@pytest.fixture(scope="module")
+def workspace(tmp_path_factory):
+    """Synthetic dataset tree + model/strategy/inspect configs."""
+    import cv2
+
+    from raft_meets_dicl_tpu.data import io
+
+    root = tmp_path_factory.mktemp("cli")
+    scene = root / "data/training/clean/alley_1"
+    flows = root / "data/training/flow/alley_1"
+    scene.mkdir(parents=True)
+    flows.mkdir(parents=True)
+
+    rs = np.random.RandomState(0)
+    for i in range(1, 4):
+        cv2.imwrite(str(scene / f"frame_{i:04d}.png"),
+                    (rs.rand(64, 96, 3) * 255).astype(np.uint8))
+    for i in range(1, 3):
+        io.write_flow_mb(str(flows / f"frame_{i:04d}.flo"),
+                         rs.randn(64, 96, 2).astype(np.float32))
+
+    (root / "dsspec.yaml").write_text("""
+name: Fake Sintel
+id: fake-sintel
+path: ./data
+
+layout:
+  type: generic
+  images: 'training/{pass}/{scene}/frame_{idx:04d}.png'
+  flows: 'training/flow/{scene}/frame_{idx:04d}.flo'
+  key: '{scene}/frame_{idx:04d}'
+
+parameters:
+  pass:
+    values: [clean]
+    sub: pass
+""")
+    (root / "data.yaml").write_text("""
+type: dataset
+spec: ./dsspec.yaml
+""")
+    (root / "model.yaml").write_text("""
+name: RAFT tiny
+id: raft/tiny
+model:
+  type: raft/baseline
+  parameters: {corr-levels: 2, corr-radius: 2, corr-channels: 32,
+               context-channels: 16, recurrent-channels: 16}
+  arguments: {iterations: 2}
+loss:
+  type: raft/sequence
+input:
+  padding: {type: modulo, mode: zeros, size: [8, 8]}
+""")
+    (root / "strategy.yaml").write_text("""
+mode: continuous
+stages:
+  - name: Stage 0
+    id: fake/s0
+    data:
+      epochs: 1
+      batch-size: 1
+      source: ./data.yaml
+    validation:
+      - name: val
+        source: ./data.yaml
+        batch-size: 1
+        images: [0]
+    optimizer:
+      type: adam-w
+      parameters: {lr: 0.0004, weight_decay: 0.00001}
+""")
+    (root / "inspect.yaml").write_text("""
+metrics:
+  - prefix: 'Train:S{n_stage}:{id_stage}/'
+    metrics: [{type: epe}, {type: loss}]
+checkpoints:
+  path: checkpoints
+  name: '{id_model}-s{n_stage}_e{n_epoch}_b{n_steps}-epe{m_EndPointError_mean:.4f}.ckpt'
+  compare: ['{m_EndPointError_mean}']
+  keep: {latest: 2, best: 2}
+validation:
+  - type: strategy
+    frequency: epoch
+    checkpoint: true
+    metrics: [{reduce: mean, metric: {type: epe}}]
+""")
+    return root
+
+
+def _cli(*args, cwd):
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "main.py"), *args],
+        cwd=cwd, capture_output=True, text=True, timeout=900,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return proc
+
+
+def test_cli_train_eval_roundtrip(workspace):
+    runs = workspace / "runs"
+
+    # train one epoch
+    _cli("train", "-d", str(workspace / "strategy.yaml"),
+         "-m", str(workspace / "model.yaml"),
+         "-i", str(workspace / "inspect.yaml"),
+         "-o", str(runs), "--limit-steps", "2", cwd=workspace)
+
+    run_dir = next(runs.iterdir())
+    assert (run_dir / "config.json").exists()
+    assert (run_dir / "model.txt").exists()
+    ckpts = list((run_dir / "checkpoints").glob("*.ckpt"))
+    assert ckpts, "validation did not create a checkpoint"
+
+    # checkpoint info
+    proc = _cli("checkpoint", "info", str(run_dir / "checkpoints"),
+                cwd=workspace)
+    assert "raft/tiny" in proc.stdout
+    assert "EndPointError/mean" in proc.stdout
+
+    # evaluate with a JSON report
+    report = workspace / "report.json"
+    _cli("evaluate", "-d", str(workspace / "data.yaml"),
+         "-m", str(workspace / "model.yaml"), "-c", str(ckpts[0]),
+         "-o", str(report), cwd=workspace)
+    result = json.loads(report.read_text())
+    assert len(result["samples"]) == 2
+    assert "EndPointError/mean" in result["summary"]["mean"]
+
+    # gencfg → retrain from the full config
+    full = workspace / "full.json"
+    _cli("gencfg", "-o", str(full),
+         "-d", str(workspace / "strategy.yaml"),
+         "-m", str(workspace / "model.yaml"),
+         "-i", str(workspace / "inspect.yaml"), cwd=workspace)
+    cfg = json.loads(full.read_text())
+    assert cfg["model"]["id"] == "raft/tiny"
+
+    _cli("train", "--config", str(full), "-o", str(workspace / "runs2"),
+         "--limit-steps", "1", cwd=workspace)
+    assert list((workspace / "runs2").iterdir())
