@@ -33,11 +33,14 @@ class ConvBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, train=False, frozen_bn=False):
+        # explicit torch-convention padding (flax 'SAME' shifts strided
+        # convs by one pixel on even inputs)
         x = nn.Conv(
             self.c_out,
             (self.kernel_size, self.kernel_size),
             strides=self.stride,
             kernel_dilation=self.dilation,
+            padding=self.dilation * (self.kernel_size // 2),
             use_bias=False,
         )(x)
         x = Norm2d(self.norm_type, self.num_groups)(x, train and not frozen_bn)
@@ -74,7 +77,8 @@ class GaConv2xBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, res, train=False, frozen_bn=False):
-        x = nn.Conv(self.c_out, (3, 3), strides=2, use_bias=False)(x)
+        x = nn.Conv(self.c_out, (3, 3), strides=2, padding=1,
+                    use_bias=False)(x)
         x = nn.relu(x)
 
         assert x.shape == res.shape

@@ -31,7 +31,9 @@ class ResidualBlock(nn.Module):
         groups = self.out_planes // 8
         norm_train = train and not frozen_bn
 
-        y = nn.Conv(self.out_planes, (3, 3), strides=self.stride,
+        # explicit symmetric padding: flax 'SAME' pads (0, 1) on strided
+        # convs over even inputs where torch pads (1, 1) — one-pixel shift
+        y = nn.Conv(self.out_planes, (3, 3), strides=self.stride, padding=1,
                     kernel_init=kaiming_normal, dtype=self.dtype)(x)
         y = Norm2d(self.norm_type, groups, dtype=self.dtype)(y, norm_train)
         y = nn.relu(y)

@@ -123,9 +123,14 @@ class FeatureEncoderPyramid(nn.Module):
         x = _Stem(self.norm_type, dtype=dt)(x, train, frozen_bn)  # 1/8, 128ch
 
         stage_channels = (160, 192, 224)
+        # per-level head widths grow with the pyramid: out3..out6 use
+        # 160/192/224/256 intermediates (reference raft/p35.py:47-49,
+        # p36.py:52-55)
         outputs = []
         for i in range(self.levels):
-            out = EncoderOutputNet(self.output_dim, norm_type=self.norm_type,
+            out = EncoderOutputNet(self.output_dim,
+                                   intermediate_dim=160 + 32 * i,
+                                   norm_type=self.norm_type,
                                    dtype=dt)(x, train, frozen_bn)
             if self.dropout > 0:
                 out = _drop2d(out, self.dropout, train)

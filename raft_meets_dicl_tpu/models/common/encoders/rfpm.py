@@ -35,7 +35,8 @@ class RfpmRfdBlock(nn.Module):
     def __call__(self, x, train=False, frozen_bn=False):
         groups = max(self.c_out // 8, 1)
 
-        y = nn.Conv(self.c_out, (3, 3), strides=self.stride,
+        # explicit padding: flax 'SAME' shifts strided convs by one pixel
+        y = nn.Conv(self.c_out, (3, 3), strides=self.stride, padding=1,
                     kernel_init=kaiming_normal)(x)
         y = Norm2d(self.norm_type, groups)(y, train and not frozen_bn)
         y = nn.relu(y)

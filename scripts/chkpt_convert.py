@@ -97,21 +97,7 @@ def _raft_rules():
         rules[f"{flax_enc}.Conv_0"] = f"{torch_enc}.conv2"
 
     step = "ScanCheckpoint_RaftStep_0"
-    enc = f"{step}.BasicUpdateBlock_0.BasicMotionEncoder_0"
-    rules[f"{enc}.Conv_0"] = "update_block.encoder.convc1"
-    rules[f"{enc}.Conv_1"] = "update_block.encoder.convc2"
-    rules[f"{enc}.Conv_2"] = "update_block.encoder.convf1"
-    rules[f"{enc}.Conv_3"] = "update_block.encoder.convf2"
-    rules[f"{enc}.Conv_4"] = "update_block.encoder.conv"
-
-    gru = f"{step}.BasicUpdateBlock_0.SepConvGru_0"
-    for i, name in enumerate(("convz1", "convr1", "convq1",
-                              "convz2", "convr2", "convq2")):
-        rules[f"{gru}.Conv_{i}"] = f"update_block.gru.{name}"
-
-    head = f"{step}.BasicUpdateBlock_0.FlowHead_0"
-    rules[f"{head}.Conv_0"] = "update_block.flow_head.conv1"
-    rules[f"{head}.Conv_1"] = "update_block.flow_head.conv2"
+    rules |= _update_block_rules(f"{step}.BasicUpdateBlock_0", "update_block")
 
     # the upsampling network lives outside the scan (batched application)
     rules["Up8Network_0.Conv_0"] = "update_block.mask.0"
@@ -176,6 +162,21 @@ def _fill_variables(variables, torch_state, rules):
     return filled, unused
 
 
+def _make_checkpoint(model_id, filled, metadata):
+    from flax import serialization
+
+    return Checkpoint(
+        model=model_id,
+        iteration=Iteration(0, 0, 0),
+        metrics={},
+        state=State(
+            model=serialization.to_state_dict(filled),
+            optimizer=None, scaler=None, lr_sched_inst=[], lr_sched_epoch=[],
+        ),
+        metadata=metadata,
+    )
+
+
 def _permute_mask_head(filled):
     """The flax Up8 mask head orders its 576 output channels
     (subpixel, neighbor) — torch RAFT orders them (neighbor, subpixel);
@@ -208,18 +209,7 @@ def convert_raft(torch_state, metadata):
 
     _permute_mask_head(filled)
 
-    from flax import serialization
-
-    return Checkpoint(
-        model="raft/baseline",
-        iteration=Iteration(0, 0, 0),
-        metrics={},
-        state=State(
-            model=serialization.to_state_dict(filled),
-            optimizer=None, scaler=None, lr_sched_inst=[], lr_sched_epoch=[],
-        ),
-        metadata=metadata,
-    )
+    return _make_checkpoint("raft/baseline", filled, metadata)
 
 
 # jytime/DICL-Flow naming → canonical prefixes (the same renames the
@@ -344,23 +334,195 @@ def convert_dicl(torch_state, metadata):
     if unused:
         logging.warning(f"unused torch keys: {sorted(unused)}")
 
-    from flax import serialization
+    return _make_checkpoint("dicl/baseline", filled, metadata)
 
-    return Checkpoint(
-        model="dicl/baseline",
-        iteration=Iteration(0, 0, 0),
-        metrics={},
-        state=State(
-            model=serialization.to_state_dict(filled),
-            optimizer=None, scaler=None, lr_sched_inst=[], lr_sched_epoch=[],
-        ),
-        metadata=metadata,
-    )
+
+# ---------------------------------------------------------------------------
+# raft+dicl coarse-to-fine (reference raft_dicl_ctf_l{2,3,4}.py) — these
+# checkpoints only come from the reference framework itself, so the source
+# naming is its module tree (fnet/cnet pyramid, corr_{lvl}, update_block,
+# upnet, upnet_h).
+
+# reference BasicUpdateBlock children are .enc/.gru/.flow (raft.py:283-285);
+# normalize the shared and the per-level spellings alike
+_CTF_PFX = [
+    ("module.", ""),
+    ("update_block.enc.", "update_block.encoder."),
+    ("update_block.flow.", "update_block.flow_head."),
+] + [
+    (f"update_block_{lvl}.{old}", f"update_block_{lvl}.{new}")
+    for lvl in range(3, 7)
+    for old, new in (("enc.", "encoder."), ("flow.", "flow_head."))
+]
+
+
+def _pyramid_rules(flax_enc, torch_enc, levels):
+    """Rules for one FeatureEncoderPyramid against a reference p3x encoder
+    (p34/p35/p36: stem layer1-3, heads out3..out6 with growing widths,
+    inter-level stages layer4..layer6)."""
+    rules = {}
+    for frag, tgt in _stem_rules(torch_enc).items():
+        rules[f"{flax_enc}._Stem_0.{frag}"] = tgt
+
+    for i in range(levels):
+        head = f"{flax_enc}.EncoderOutputNet_{i}"
+        out = f"{torch_enc}.out{i + 3}"
+        rules[f"{head}.Conv_0"] = f"{out}.conv1"
+        rules[f"{head}.Norm2d_0.BatchNorm_0"] = f"{out}.norm1"
+        rules[f"{head}.Conv_1"] = f"{out}.conv2"
+
+    for j in range(levels - 1):
+        for k in range(2):
+            blk = f"{flax_enc}.ResidualBlock_{2 * j + k}"
+            tgt = f"{torch_enc}.layer{4 + j}.{k}"
+            rules[f"{blk}.Conv_0"] = f"{tgt}.conv1"
+            rules[f"{blk}.Conv_1"] = f"{tgt}.conv2"
+            rules[f"{blk}.Conv_2"] = f"{tgt}.downsample.0"
+            rules[f"{blk}.Norm2d_0.BatchNorm_0"] = f"{tgt}.norm1"
+            rules[f"{blk}.Norm2d_1.BatchNorm_0"] = f"{tgt}.norm2"
+            rules[f"{blk}.Norm2d_2.BatchNorm_0"] = f"{tgt}.downsample.1"
+    return rules
+
+
+def _cmod_rules(flax_path, torch_path):
+    """Rules for one DICL CorrelationModule (MatchingNet hourglass + DAP)."""
+    rules = {}
+    mnet = f"{flax_path}.MatchingNet_0"
+    for i in range(4):
+        rules[f"{mnet}.ConvBlock_{i}.Conv_0"] = f"{torch_path}.mnet.{i}.0"
+        rules[f"{mnet}.ConvBlock_{i}.Norm2d_0.BatchNorm_0"] = \
+            f"{torch_path}.mnet.{i}.1"
+    rules[f"{mnet}.ConvBlockTransposed_0.ConvTranspose_0"] = \
+        f"{torch_path}.mnet.4.0"
+    rules[f"{mnet}.ConvBlockTransposed_0.Norm2d_0.BatchNorm_0"] = \
+        f"{torch_path}.mnet.4.1"
+    rules[f"{mnet}.Conv_0"] = f"{torch_path}.mnet.5"
+    rules[f"{flax_path}.DisplacementAwareProjection_0.Conv_0"] = \
+        f"{torch_path}.dap.conv1"
+    return rules
+
+
+def _update_block_rules(flax_path, torch_path):
+    """Rules for one (normalized) BasicUpdateBlock."""
+    rules = {}
+    enc = f"{flax_path}.BasicMotionEncoder_0"
+    for i, name in enumerate(("convc1", "convc2", "convf1", "convf2", "conv")):
+        rules[f"{enc}.Conv_{i}"] = f"{torch_path}.encoder.{name}"
+    gru = f"{flax_path}.SepConvGru_0"
+    for i, name in enumerate(("convz1", "convr1", "convq1",
+                              "convz2", "convr2", "convq2")):
+        rules[f"{gru}.Conv_{i}"] = f"{torch_path}.gru.{name}"
+    head = f"{flax_path}.FlowHead_0"
+    rules[f"{head}.Conv_0"] = f"{torch_path}.flow_head.conv1"
+    rules[f"{head}.Conv_1"] = f"{torch_path}.flow_head.conv2"
+    return rules
+
+
+def _ctf_rules(levels, share_dicl, share_rnn, upsample_hidden):
+    """flax module path → (normalized) torch path for raft+dicl/ctf-l*.
+
+    Flax submodule suffixes follow creation order in
+    RaftPlusDiclCtfModule.__call__ — coarse→fine over
+    ``level_ids = (levels+2 .. 3)``, so suffix i corresponds to reference
+    ``corr_{level_ids[i]}`` / ``update_block_{level_ids[i]}``.
+    """
+    level_ids = tuple(range(levels + 2, 2, -1))
+    rules = {}
+
+    rules |= _pyramid_rules("FeatureEncoderPyramid_0", "fnet", levels)
+    rules |= _pyramid_rules("FeatureEncoderPyramid_1", "cnet", levels)
+
+    for i, lvl in enumerate(level_ids):
+        rules |= _cmod_rules(
+            f"CorrelationModule_{0 if share_dicl else i}",
+            "corr" if share_dicl else f"corr_{lvl}",
+        )
+        rules |= _update_block_rules(
+            f"BasicUpdateBlock_{0 if share_rnn else i}",
+            "update_block" if share_rnn else f"update_block_{lvl}",
+        )
+
+    for i, lvl in enumerate(level_ids[1:]):
+        flax_h = 0 if share_rnn else i
+        # the reference l2 variant has a single transition and names its
+        # upsampler 'upnet_h' regardless of sharing (raft_dicl_ctf_l2.py:68)
+        torch_h = "upnet_h" if share_rnn or levels == 2 else f"upnet_h_{lvl}"
+        if upsample_hidden == "bilinear":
+            rules[f"HUpBilinear_{flax_h}.Conv_0"] = f"{torch_h}.conv1"
+        elif upsample_hidden == "crossattn":
+            for j, name in enumerate(("conv_q", "conv_k", "conv_v_prev",
+                                      "conv_v_init", "conv_out")):
+                rules[f"HUpCrossAttn_{flax_h}.Conv_{j}"] = f"{torch_h}.{name}"
+
+    rules["Up8Network_0.Conv_0"] = "upnet.conv1"
+    rules["Up8Network_0.Conv_1"] = "upnet.conv2"
+    return rules
+
+
+def convert_raft_dicl(torch_state, metadata):
+    """Reference raft+dicl/ctf-l{2,3,4} checkpoint → same model id here.
+
+    Pyramid depth, module sharing, and the hidden-state upsampler are
+    auto-detected from the state-dict key set.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    state = _normalize(torch_state, _CTF_PFX)
+
+    # p34/p35/p36 carry heads out3..out{levels+2}
+    levels = max(
+        lvl for lvl in (4, 5, 6)
+        if any(k.startswith(f"fnet.out{lvl}.") for k in state)
+    ) - 2
+    share_dicl = any(k.startswith("corr.") for k in state)
+    share_rnn = any(k.startswith("update_block.") for k in state)
+    if any(k.startswith("upnet_h.conv_q") for k in state) or \
+            any(k.startswith("upnet_h_4.conv_q") for k in state):
+        upsample_hidden = "crossattn"
+    elif any(k.startswith(("upnet_h.", "upnet_h_4.")) for k in state):
+        upsample_hidden = "bilinear"
+    else:
+        upsample_hidden = "none"
+
+    model_id = f"raft+dicl/ctf-l{levels}"
+    pad = 8 * 2 ** (levels - 1)
+
+    spec = models.load({
+        "name": f"RAFT+DICL ctf-l{levels}", "id": model_id,
+        "model": {
+            "type": model_id,
+            "parameters": {
+                "share-dicl": share_dicl,
+                "share-rnn": share_rnn,
+                "upsample-hidden": upsample_hidden,
+            },
+        },
+        "loss": {"type": "raft+dicl/mlseq"},
+        "input": {"padding": {"type": "modulo", "mode": "zeros",
+                              "size": [pad, pad]}},
+    })
+    # the coarsest-level maps must have even extent (MatchingNet's
+    # stride-2 + 2x-transposed round trip), so trace at 2·pad multiples
+    img = jnp.zeros((1, 2 * pad, 4 * pad, 3), jnp.float32)
+    variables = spec.model.init(
+        jax.random.PRNGKey(0), img, img, iterations=(1,) * levels)
+
+    filled, unused = _fill_variables(
+        variables, state,
+        _ctf_rules(levels, share_dicl, share_rnn, upsample_hidden))
+    if unused:
+        logging.warning(f"unused torch keys: {sorted(unused)}")
+
+    _permute_mask_head(filled)
+
+    return _make_checkpoint(model_id, filled, metadata)
 
 
 CONVERTERS = {
     "raft": convert_raft,
     "dicl": convert_dicl,
+    "raft+dicl": convert_raft_dicl,
 }
 
 

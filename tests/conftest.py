@@ -19,4 +19,9 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# XLA's CPU backend routes f32 convs/matmuls through oneDNN at reduced
+# precision by default (~2e-3 relative error) — numerical-parity tests
+# against torch need true f32
+jax.config.update("jax_default_matmul_precision", "highest")
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,251 @@
+"""Full-model forward parity against the reference torch implementation.
+
+The reference source tree is importable at /root/reference (package
+``src``) and torch is installed in this environment. Each test
+instantiates the reference torch module with default hyperparameters,
+randomizes its weights and batch-norm statistics, maps the state dict
+onto the flax variable tree through the scripts/chkpt_convert rules, and
+asserts both frameworks compute the same function on identical inputs.
+
+This is what makes the EPE-parity goal falsifiable without datasets:
+op-level parity (tests/test_ops_parity.py) and weight-mapping round
+trips (tests/test_chkpt_convert.py) are necessary but not sufficient — a
+misplaced norm, padding mode, or channel-order mismatch composes
+individually-correct ops and still diverges. A full forward catches it.
+
+Covers: raft/baseline (reference src/models/impls/raft.py:372-433),
+dicl/baseline (dicl.py:150-300), raft+dicl/ctf-l3 (the thesis flagship,
+raft_dicl_ctf_l3.py:79-260).
+"""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+import torch
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "scripts"))
+sys.path.insert(0, "/root/reference")
+
+# importing src.models pulls in src/__init__ → src.data, which imports
+# dataset-pipeline deps not installed here; the model code never touches
+# them, so satisfy the imports with empty stubs
+import types  # noqa: E402
+
+for _name in ("torchvision", "torchvision.transforms", "parse", "git"):
+    if _name not in sys.modules:
+        try:
+            __import__(_name)
+        except ImportError:
+            sys.modules[_name] = types.ModuleType(_name)
+
+import chkpt_convert as cc  # noqa: E402
+
+pytestmark = pytest.mark.slow
+
+
+def _randomize_batchnorm(module, seed):
+    """Fresh torch models carry degenerate BN state (mean 0, var 1,
+    scale 1, bias 0) — a wrong stats mapping would be invisible.
+    Randomize so the batch_stats transfer is actually exercised."""
+    g = torch.Generator().manual_seed(seed)
+    with torch.no_grad():
+        for m in module.modules():
+            if isinstance(m, torch.nn.BatchNorm2d):
+                m.running_mean.uniform_(-0.5, 0.5, generator=g)
+                m.running_var.uniform_(0.5, 1.5, generator=g)
+                m.weight.uniform_(0.5, 1.5, generator=g)
+                m.bias.uniform_(-0.5, 0.5, generator=g)
+
+
+def _images(shape, seed):
+    rng = np.random.default_rng(seed)
+    img1 = rng.uniform(-1.0, 1.0, size=shape).astype(np.float32)
+    img2 = rng.uniform(-1.0, 1.0, size=shape).astype(np.float32)
+    return img1, img2
+
+
+def _nchw(x):
+    return torch.from_numpy(np.transpose(x, (0, 3, 1, 2))).contiguous()
+
+
+def _nhwc(t):
+    return np.transpose(t.detach().numpy(), (0, 2, 3, 1))
+
+
+def _restore(spec, chkpt, img_shape, **init_kwargs):
+    """Init the flax variables and load the converted checkpoint into them."""
+    import jax
+    import jax.numpy as jnp
+    from flax import serialization
+
+    img = jnp.zeros(img_shape, jnp.float32)
+    variables = spec.model.init(jax.random.PRNGKey(0), img, img, **init_kwargs)
+    return serialization.from_state_dict(variables, chkpt.state.model)
+
+
+def _assert_flow_lists_match(torch_flows, flax_flows, atol, label):
+    assert len(torch_flows) == len(flax_flows), (
+        f"{label}: {len(torch_flows)} torch outputs "
+        f"vs {len(flax_flows)} flax outputs"
+    )
+    for i, (tf, ff) in enumerate(zip(torch_flows, flax_flows)):
+        t = _nhwc(tf)
+        f = np.asarray(ff)
+        assert t.shape == f.shape, f"{label}[{i}]: {t.shape} vs {f.shape}"
+        diff = np.abs(t - f).max()
+        assert diff <= atol, f"{label}[{i}]: max |Δflow| = {diff:.2e} > {atol}"
+
+
+def test_raft_baseline_forward_parity():
+    import raft_meets_dicl_tpu.models as models
+    from src.models.impls import raft as ref_raft
+
+    torch.manual_seed(7)
+    tmod = ref_raft.RaftModule()
+    _randomize_batchnorm(tmod, 70)
+    tmod.eval()
+
+    chkpt = cc.convert_raft(dict(tmod.state_dict()), {})
+
+    spec = models.load({
+        "name": "RAFT baseline", "id": "raft/baseline",
+        "model": {"type": "raft/baseline", "parameters": {}},
+        "loss": {"type": "raft/sequence"},
+        "input": {"padding": {"type": "modulo", "mode": "zeros",
+                              "size": [8, 8]}},
+    })
+
+    # the reference corr pyramid needs the 1/8 map ≥ 16 px per side — a
+    # coarsest level of width 1 makes grid_sample divide by (w-1) = 0
+    img1, img2 = _images((1, 128, 160, 3), 170)
+    variables = _restore(spec, chkpt, (1, 128, 160, 3), iterations=1)
+
+    with torch.no_grad():
+        t_out = tmod(_nchw(img1), _nchw(img2), iterations=12)
+    f_out = spec.model.apply(variables, img1, img2, iterations=12)
+
+    _assert_flow_lists_match(t_out, f_out, 1e-3, "raft flow")
+
+
+def test_raft_dicl_ctf_l3_forward_parity():
+    import raft_meets_dicl_tpu.models as models
+    from src.models.impls import raft_dicl_ctf_l3 as ref_ctf
+
+    torch.manual_seed(8)
+    tmod = ref_ctf.RaftPlusDiclModule()
+    _randomize_batchnorm(tmod, 80)
+    tmod.eval()
+
+    chkpt = cc.convert_raft_dicl(dict(tmod.state_dict()), {})
+    assert chkpt.model == "raft+dicl/ctf-l3"
+
+    spec = models.load({
+        "name": "RAFT+DICL ctf-l3", "id": "raft+dicl/ctf-l3",
+        "model": {"type": "raft+dicl/ctf-l3", "parameters": {}},
+        "loss": {"type": "raft+dicl/mlseq"},
+        "input": {"padding": {"type": "modulo", "mode": "zeros",
+                              "size": [32, 32]}},
+    })
+
+    # multiples of 64: the 1/32-scale maps must have even extent
+    # (MatchingNet downsamples by 2 and upsamples back)
+    img1, img2 = _images((1, 128, 192, 3), 180)
+    variables = _restore(spec, chkpt, (1, 128, 192, 3),
+                         iterations=(1, 1, 1))
+
+    with torch.no_grad():
+        t_out = tmod(_nchw(img1), _nchw(img2), iterations=(4, 3, 3))
+    f_out = spec.model.apply(variables, img1, img2, iterations=(4, 3, 3))
+
+    # reference returns (out_5, out_4, out_3) iteration lists; ours is the
+    # same structure as a list
+    assert len(t_out) == len(f_out) == 3
+    for lvl, (t_lvl, f_lvl) in enumerate(zip(t_out, f_out)):
+        _assert_flow_lists_match(t_lvl, f_lvl, 1e-3, f"ctf-l3 level {lvl}")
+
+
+def _ref_dicl_state_to_jytime(state):
+    """Rename the reference DiclModule's own state-dict keys to the jytime
+    naming that convert_dicl consumes (inverse of the renames in reference
+    scripts/chkpt_convert.py:53-90)."""
+    sub = []
+
+    blocks = [f"conv0.{x}" for x in range(3)]
+    blocks += [f"conv{x}a" for x in range(1, 7)]
+    blocks += [f"outconv{x}" for x in range(2, 7)]
+    for b in blocks:
+        sub += [(f"feature.{b}.0.", f"feature.{b}.conv."),
+                (f"feature.{b}.1.", f"feature.{b}.bn.")]
+
+    ga = [f"deconv{x}a" for x in range(1, 7)]
+    ga += [f"deconv{x}b" for x in range(2, 7)]
+    ga += [f"conv{x}b" for x in range(1, 7)]
+    for c in ga:
+        sub += [(f"feature.{c}.conv1.", f"feature.{c}.conv1.conv."),
+                (f"feature.{c}.conv2.", f"feature.{c}.conv2.conv."),
+                (f"feature.{c}.bn2.", f"feature.{c}.conv2.bn.")]
+
+    for lvl in range(2, 7):
+        sub.append((f"lvl{lvl}.mnet.5.", f"matching{lvl}.match.5."))
+        for x in range(5):
+            sub += [(f"lvl{lvl}.mnet.{x}.0.", f"matching{lvl}.match.{x}.conv."),
+                    (f"lvl{lvl}.mnet.{x}.1.", f"matching{lvl}.match.{x}.bn.")]
+        sub.append((f"lvl{lvl}.dap.conv1.", f"dap{lvl}."))
+        for x in range(7):
+            sub += [(f"lvl{lvl}.ctxnet.{x}.0.", f"context_net{lvl}.{x}.conv."),
+                    (f"lvl{lvl}.ctxnet.{x}.1.", f"context_net{lvl}.{x}.bn.")]
+        # final plain conv (carries weight+bias directly)
+        sub.append((f"lvl{lvl}.ctxnet.", f"context_net{lvl}."))
+
+    out = {}
+    for k, v in state.items():
+        for old, new in sub:
+            if k.startswith(old):
+                k = new + k[len(old):]
+        out[k] = v
+    return out
+
+
+def test_dicl_baseline_forward_parity():
+    import raft_meets_dicl_tpu.models as models
+    from src.models.impls import dicl as ref_dicl
+
+    disp_ranges = {f"level-{lvl}": [3, 3] for lvl in range(2, 7)}
+
+    torch.manual_seed(9)
+    tmod = ref_dicl.DiclModule(disp_ranges=disp_ranges)
+    _randomize_batchnorm(tmod, 90)
+    tmod.eval()
+
+    state = _ref_dicl_state_to_jytime(dict(tmod.state_dict()))
+    chkpt = cc.convert_dicl(state, {})
+
+    spec = models.load({
+        "name": "DICL baseline", "id": "dicl/baseline",
+        "model": {
+            "type": "dicl/baseline",
+            "parameters": {"displacement-range": disp_ranges},
+        },
+        "loss": {"type": "dicl/multiscale",
+                 "arguments": {"weights": [1.0] * 10}},
+        "input": {"padding": {"type": "modulo", "mode": "zeros",
+                              "size": [128, 128]}},
+    })
+
+    # multiples of 128 (the GA-Net hourglass reaches 1/128), and the
+    # 1/64 maps must exceed the ±3 displacement range
+    img1, img2 = _images((1, 256, 384, 3), 190)
+    variables = _restore(spec, chkpt, (1, 256, 384, 3))
+
+    with torch.no_grad():
+        t_out = tmod(_nchw(img1), _nchw(img2), raw=True)
+    f_out = spec.model.apply(variables, img1, img2, raw=True)
+
+    # coarse-to-fine warping amplifies f32 rounding ~4-6x per level: the
+    # measured drift is 6e-6 at level 6 growing monotonically to ~1e-2 at
+    # level 2 — numerical accumulation, not structure (any structural
+    # mismatch shows up as O(1) at the level it happens)
+    _assert_flow_lists_match(t_out, f_out, 2e-2, "dicl flow")
