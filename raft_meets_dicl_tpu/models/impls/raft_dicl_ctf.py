@@ -45,6 +45,53 @@ _PYRAMIDS = {
 _DEFAULT_ITERATIONS = {2: (4, 3), 3: (4, 3, 3), 4: (3, 4, 4, 3)}
 
 
+class _CtfStep(nn.Module):
+    """One RAFT+DICL iteration at a fixed pyramid level — the nn.scan body.
+
+    All parameterized submodules are passed in as shared instances created
+    in the parent scope, so parameter paths (and with them checkpoints and
+    the torch-importer rules) are identical to the unrolled form, and level
+    sharing (``share_dicl`` / ``share_rnn``) composes freely with the scan:
+    the scan only owns the loop, never the weights.
+    """
+
+    cmod: nn.Module
+    reg: nn.Module
+    update: nn.Module
+    dap: bool
+    corr_grad_stop: bool
+    train: bool
+    frozen_bn: bool
+
+    @nn.compact
+    def __call__(self, carry, _, f1, f2, x, coords0):
+        from jax.ad_checkpoint import checkpoint_name
+
+        h, coords1 = carry
+        coords1 = jax.lax.stop_gradient(coords1)
+        prev = coords1 - coords0
+
+        corr = self.cmod(f1, f2, coords1, dap=self.dap, train=self.train,
+                         frozen_bn=self.frozen_bn)
+        # saved under the remat policy: recomputing the MatchingNet over
+        # all (2r+1)² displacements in the backward pass costs far more
+        # than the (B, H, W, (2r+1)²) cost volume it would save
+        corr = checkpoint_name(corr, "corr_features")
+
+        # readout is always computed so the regression params exist
+        # regardless of the static corr_flow switch; XLA removes it when
+        # the output is unused
+        readout = prev + self.reg(corr)
+
+        if self.corr_grad_stop:
+            corr = jax.lax.stop_gradient(corr)
+
+        h, d = self.update(h, x, corr, prev)
+        coords1 = coords1 + d
+
+        return (h, coords1), (coords1 - coords0, h, readout, prev)
+
+
 class RaftPlusDiclCtfModule(nn.Module):
     """Coarse-to-fine RAFT+DICL network over ``levels`` pyramid levels
     (finest always 1/8; coarsest 1/(8·2^(levels-1)))."""
@@ -67,6 +114,8 @@ class RaftPlusDiclCtfModule(nn.Module):
     share_dicl: bool = False
     share_rnn: bool = True
     upsample_hidden: str = "none"
+    remat: bool = True
+    unroll: bool = False
 
     def _make_cmod(self):
         return corr_mod.make_cmod(
@@ -133,7 +182,14 @@ class RaftPlusDiclCtfModule(nn.Module):
                 for lvl in level_ids[1:]
             }
 
-        upnet8 = Up8Network()
+        # remat'd batched convex upsampler, pinned name for checkpoint
+        # stability (the wrapper would otherwise prefix the module path)
+        upnet8 = nn.remat(Up8Network, prevent_cse=False)(name="Up8Network_0")
+
+        # the lifted scan broadcasts batch_stats read-only; when batch norm
+        # actually trains (rare — stages default to freeze_batchnorm) the
+        # sequential running-stat updates need the python-unrolled loop
+        unrolled = self.unroll or (train and not frozen_bn)
 
         out = []
         flow = None
@@ -143,11 +199,11 @@ class RaftPlusDiclCtfModule(nn.Module):
             scale = 2 ** lvl
             lh, lw = h // scale, w // scale
             fine_idx = lvl - 3  # index into finest-first feature tuples
+            n_iter = iterations[li]
 
             coords0 = coordinate_grid(b, lh, lw)
             if flow is None:
                 coords1 = coords0
-                flow = coords1 - coords0
             else:
                 flow = upsample_flow_2x(flow)
                 coords1 = coords0 + flow
@@ -160,41 +216,80 @@ class RaftPlusDiclCtfModule(nn.Module):
             x = context[fine_idx]
             finest = li == self.levels - 1
 
-            out_lvl, out_prev, out_corr = [], [], []
-            for _ in range(iterations[li]):
-                coords1 = jax.lax.stop_gradient(coords1)
+            # one (remat-wrapped) step body serves both realizations:
+            # iterations share spatial shapes within a level, and remat
+            # recomputes iteration activations in the backward pass
+            # instead of storing every MatchingNet intermediate (the
+            # raft/baseline scan discipline, models/impls/raft.py:322-352)
+            if self.remat:
+                body = nn.remat(
+                    _CtfStep, prevent_cse=False,
+                    policy=jax.checkpoint_policies.save_only_these_names(
+                        "corr_features"),
+                )
+            else:
+                body = _CtfStep
+            shared = dict(
+                cmod=cmods[lvl], reg=regs[lvl], update=updates[lvl],
+                dap=dap, corr_grad_stop=corr_grad_stop,
+                train=train, frozen_bn=frozen_bn,
+            )
 
-                if prev_flow:
-                    out_prev.append(jax.lax.stop_gradient(flow))
+            if unrolled:
+                # python loop over the same step module — sequential
+                # batch-stat updates, identical parameter paths
+                step = body(**shared)
+                carry = (h_state, coords1)
+                flows, hiddens, readouts, prevs = [], [], [], []
+                for _ in range(n_iter):
+                    carry, (fl, hi, ro, pv) = step(
+                        carry, jnp.zeros((0,)),
+                        f1[fine_idx], f2[fine_idx], x, coords0,
+                    )
+                    flows.append(fl)
+                    hiddens.append(hi)
+                    readouts.append(ro)
+                    prevs.append(pv)
+                h_state, coords1 = carry
 
-                corr = cmods[lvl](
-                    f1[fine_idx], f2[fine_idx], coords1, dap=dap,
-                    train=train, frozen_bn=frozen_bn,
+                flows = jnp.stack(flows)
+                hiddens = jnp.stack(hiddens)
+                readouts = jnp.stack(readouts)
+                prevs = jnp.stack(prevs)
+            else:
+                step = nn.scan(
+                    body,
+                    variable_broadcast=["params", "batch_stats"],
+                    split_rngs={"params": False, "dropout": True},
+                    in_axes=(0, nn.broadcast, nn.broadcast, nn.broadcast,
+                             nn.broadcast),
+                    out_axes=0,
+                )(**shared)
+
+                (h_state, coords1), (flows, hiddens, readouts, prevs) = step(
+                    (h_state, coords1), jnp.zeros((n_iter, 0)),
+                    f1[fine_idx], f2[fine_idx], x, coords0,
                 )
 
-                # readout is always called so its params exist regardless of
-                # the static switch; XLA removes the unused branch
-                readout = jax.lax.stop_gradient(flow) + regs[lvl](corr)
-                if corr_flow:
-                    out_corr.append(readout)
+            flow = flows[-1]
 
-                if corr_grad_stop:
-                    corr = jax.lax.stop_gradient(corr)
+            if finest:
+                # convex 8x upsampling, batched over all iterations at once
+                # (the raft/baseline hoist: one large einsum instead of
+                # n_iter rematerialized ones); always called so its params
+                # exist regardless of ``upnet``
+                flows_flat = flows.reshape(n_iter * b, lh, lw, 2)
+                hidden_flat = hiddens.reshape(n_iter * b, lh, lw, hdim)
+                ups = upnet8(hidden_flat, flows_flat)
+                if not upnet:
+                    ups = 8.0 * interpolate_bilinear(flows_flat, (h, w))
+                ups = ups.reshape(n_iter, b, h, w, 2)
+                out_lvl = [ups[i] for i in range(n_iter)]
+            else:
+                out_lvl = [flows[i] for i in range(n_iter)]
 
-                h_state, d = updates[lvl](
-                    h_state, x, corr, jax.lax.stop_gradient(flow))
-
-                coords1 = coords1 + d
-                flow = coords1 - coords0
-
-                if finest:
-                    # Up8 is likewise always called for param stability
-                    flow_up = upnet8(h_state, flow)
-                    if not upnet:
-                        flow_up = 8.0 * interpolate_bilinear(flow, (h, w))
-                    out_lvl.append(flow_up)
-                else:
-                    out_lvl.append(flow)
+            out_prev = [prevs[i] for i in range(n_iter)]
+            out_corr = [readouts[i] for i in range(n_iter)]
 
             if prev_flow:
                 out_lvl = list(zip(out_prev, out_lvl))

@@ -157,6 +157,44 @@ class MlCorrelationModule(nn.Module):
         return out
 
 
+class _MlStep(nn.Module):
+    """One GRU iteration — the nn.scan body. Parameterized submodules are
+    shared instances from the parent scope (see raft_dicl_ctf._CtfStep for
+    why: identical parameter paths to the unrolled loop)."""
+
+    cvol: nn.Module
+    reg: nn.Module
+    update: nn.Module
+    dap: bool
+    mask_costs: tuple
+    corr_grad_stop: bool
+    train: bool
+    frozen_bn: bool
+
+    @nn.compact
+    def __call__(self, carry, _, fmap1, fmap2, x, coords0):
+        from jax.ad_checkpoint import checkpoint_name
+
+        h, coords1 = carry
+        coords1 = jax.lax.stop_gradient(coords1)
+        flow = coords1 - coords0
+
+        corr = self.cvol(fmap1, fmap2, coords1, dap=self.dap,
+                         mask_costs=self.mask_costs, train=self.train,
+                         frozen_bn=self.frozen_bn)
+        corr = checkpoint_name(corr, "corr_features")
+
+        corr_flows = tuple(flow + d for d in self.reg(corr))
+
+        if self.corr_grad_stop:
+            corr = jax.lax.stop_gradient(corr)
+
+        h, d = self.update(h, x, corr, flow)
+        coords1 = coords1 + d
+
+        return (h, coords1), (coords1 - coords0, h, corr_flows)
+
+
 class RaftPlusDiclMlModule(nn.Module):
     """RAFT+DICL multi-level network (reference raft_dicl_ml.py:350-470)."""
 
@@ -176,6 +214,8 @@ class RaftPlusDiclMlModule(nn.Module):
     share_dicl: bool = False
     corr_reg_type: str = "softargmax"
     corr_reg_args: dict = None
+    remat: bool = True
+    unroll: bool = False
 
     @nn.compact
     def __call__(self, img1, img2, train=False, frozen_bn=False, iterations=12,
@@ -237,37 +277,77 @@ class RaftPlusDiclMlModule(nn.Module):
                                    self.corr_radius,
                                    **(self.corr_reg_args or {}))
         update = BasicUpdateBlock(hdim, dtype=dt)
-        upnet8 = Up8Network(dtype=dt)
+        # remat'd, pinned name (the wrapper would otherwise prefix the path)
+        upnet8 = nn.remat(Up8Network, prevent_cse=False)(
+            dtype=dt, name="Up8Network_0")
 
-        out = []
-        out_corr = [[] for _ in range(self.corr_levels)]
-        for _ in range(iterations):
-            coords1 = jax.lax.stop_gradient(coords1)
-            flow = coords1 - coords0
+        # one (remat-wrapped) step body serves both realizations; scan
+        # unless batch norm is actually training (the lifted scan
+        # broadcasts batch_stats read-only; see raft_dicl_ctf)
+        if self.remat:
+            body = nn.remat(
+                _MlStep, prevent_cse=False,
+                policy=jax.checkpoint_policies.save_only_these_names(
+                    "corr_features"),
+            )
+        else:
+            body = _MlStep
+        shared = dict(
+            cvol=cvol, reg=reg, update=update, dap=dap,
+            mask_costs=tuple(mask_costs), corr_grad_stop=corr_grad_stop,
+            train=train, frozen_bn=frozen_bn,
+        )
 
-            corr = cvol(fmap1, fmap2, coords1, dap=dap, mask_costs=mask_costs,
-                        train=train, frozen_bn=frozen_bn)
+        if self.unroll or (train and not frozen_bn):
+            step = body(**shared)
+            carry = (h, coords1)
+            flows, hiddens, corr_flows = [], [], []
+            for _ in range(iterations):
+                carry, (fl, hi, cf) = step(
+                    carry, jnp.zeros((0,)), fmap1, fmap2, x, coords0)
+                flows.append(fl)
+                hiddens.append(hi)
+                corr_flows.append(cf)
+            h, coords1 = carry
 
-            readouts = reg(corr)
-            if corr_flow:
-                for i, delta in enumerate(readouts):
-                    out_corr[i].append(jax.lax.stop_gradient(flow) + delta)
+            flows = jnp.stack(flows)
+            hiddens = jnp.stack(hiddens)
+            corr_flows = tuple(
+                jnp.stack([cf[lvl] for cf in corr_flows])
+                for lvl in range(self.corr_levels)
+            )
+        else:
+            step = nn.scan(
+                body,
+                variable_broadcast=["params", "batch_stats"],
+                split_rngs={"params": False, "dropout": True},
+                in_axes=(0, nn.broadcast, nn.broadcast, nn.broadcast,
+                         nn.broadcast),
+                out_axes=0,
+            )(**shared)
 
-            if corr_grad_stop:
-                corr = jax.lax.stop_gradient(corr)
+            (h, coords1), (flows, hiddens, corr_flows) = step(
+                (h, coords1), jnp.zeros((iterations, 0)),
+                fmap1, fmap2, x, coords0,
+            )
 
-            h, d = update(h, x, corr, flow)
+        # convex 8x upsampling, batched over all iterations at once
+        full_shape = (img1.shape[1], img1.shape[2])
+        flows_flat = flows.reshape(iterations * b, hc, wc, 2)
+        hiddens_flat = hiddens.reshape(iterations * b, hc, wc, hdim)
 
-            coords1 = coords1 + d
-            flow = coords1 - coords0
+        ups = upnet8(hiddens_flat, flows_flat)
+        if not upnet:
+            ups = 8.0 * interpolate_bilinear(flows_flat, full_shape)
+        ups = ups.reshape(iterations, b, *full_shape, 2)
 
-            flow_up = upnet8(h, flow)
-            if not upnet:
-                flow_up = 8.0 * interpolate_bilinear(
-                    flow, (img1.shape[1], img1.shape[2]))
-            out.append(flow_up)
+        out = [ups[i] for i in range(iterations)]
 
         if corr_flow:
+            out_corr = [
+                [corr_flows[lvl][i] for i in range(iterations)]
+                for lvl in range(self.corr_levels)
+            ]
             return [*reversed(out_corr), out]  # coarse-to-fine, then final
         return out
 

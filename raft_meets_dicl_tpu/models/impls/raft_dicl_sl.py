@@ -7,12 +7,13 @@ from a learned DICL matching network evaluated on the (2r+1)² displaced
 window around the current flow (``make_cmod``), optionally with a
 soft-argmax corr-flow readout per iteration.
 
-The iteration loop is an ``nn.scan`` with rematerialization like the RAFT
-baseline; the matching net's batch-norm statistics ride the scan carry so
-each iteration updates them exactly like the reference's sequential calls.
+The iteration loop is an ``nn.scan`` over the shared-module step body
+(``raft_dicl_ctf._CtfStep``) with rematerialization like the RAFT
+baseline; when batch norm actually trains, the loop unrolls so the
+sequential running-stat updates match the reference's.
 """
 
-from typing import Any, Tuple
+from typing import Any
 
 import flax.linen as nn
 import jax
@@ -25,67 +26,7 @@ from ..common.grid import coordinate_grid
 from ..config import register_model
 from ..model import Model, ModelAdapter
 from .raft import BasicUpdateBlock, RaftAdapter, Up8Network
-
-
-class _Step(nn.Module):
-    """One GRU iteration — the nn.scan body; carry is (hidden, coords1)."""
-
-    corr_radius: int
-    recurrent_channels: int
-    corr_type: str
-    corr_args: dict
-    corr_reg_type: str
-    corr_reg_args: dict
-    dap_init: str
-    mnet_norm: str
-    upnet: bool
-    dap: bool
-    corr_flow: bool
-    corr_grad_stop: bool
-    full_shape: Tuple[int, int]
-    train: bool = False
-    frozen_bn: bool = False
-    dtype: Any = None
-
-    @nn.compact
-    def __call__(self, carry, fmap1, fmap2, x, coords0):
-        h, coords1 = carry
-        coords1 = jax.lax.stop_gradient(coords1)
-        flow = coords1 - coords0
-
-        cvol = corr_mod.make_cmod(
-            self.corr_type, fmap1.shape[-1], radius=self.corr_radius,
-            dap_init=self.dap_init, norm_type=self.mnet_norm, **self.corr_args,
-        )
-        corr = cvol(fmap1, fmap2, coords1, dap=self.dap, train=self.train,
-                    frozen_bn=self.frozen_bn)
-
-        # always call the readout so its params exist regardless of the
-        # static switch (a '+dap' readout has a trainable projection); XLA
-        # removes the unused branch
-        reg = corr_mod.make_flow_regression(
-            self.corr_type, self.corr_reg_type, self.corr_radius,
-            **self.corr_reg_args,
-        )
-        readout = flow + reg(corr)
-        corr_flows = (readout,) if self.corr_flow else ()
-
-        if self.corr_grad_stop:
-            corr = jax.lax.stop_gradient(corr)
-
-        h, d = BasicUpdateBlock(self.recurrent_channels, dtype=self.dtype)(
-            h, x, corr, flow)
-
-        coords1 = coords1 + d
-        flow = coords1 - coords0
-
-        flow_up_net = Up8Network(dtype=self.dtype)(h, flow)
-        if self.upnet:
-            flow_up = flow_up_net
-        else:
-            flow_up = 8.0 * interpolate_bilinear(flow, self.full_shape)
-
-        return (h, coords1), (flow_up, corr_flows)
+from .raft_dicl_ctf import _CtfStep
 
 
 class RaftPlusDiclModule(nn.Module):
@@ -108,6 +49,7 @@ class RaftPlusDiclModule(nn.Module):
     encoder_type: str = "raft"
     context_type: str = "raft"
     remat: bool = True
+    unroll: bool = False
 
     @nn.compact
     def __call__(self, img1, img2, train=False, frozen_bn=False, iterations=12,
@@ -138,39 +80,81 @@ class RaftPlusDiclModule(nn.Module):
         coords0 = coordinate_grid(b, hc, wc)
         coords1 = coords0 + flow_init if flow_init is not None else coords0
 
-        # the matching net carries batch-norm statistics, which flax cannot
-        # create inside an nn.scan body — so unlike the pure RAFT scan loop,
-        # iterations unroll statically (iteration count is a static arg
-        # anyway) with remat per step for the same activation-memory story
-        body = nn.remat(_Step, prevent_cse=False) if self.remat else _Step
-        step = body(
-            corr_radius=self.corr_radius,
-            recurrent_channels=hdim,
-            corr_type=self.corr_type,
-            corr_args=self.corr_args or {},
-            corr_reg_type=self.corr_reg_type,
-            corr_reg_args=self.corr_reg_args or {},
-            dap_init=self.dap_init,
-            mnet_norm=self.mnet_norm,
-            upnet=upnet,
-            dap=dap,
-            corr_flow=corr_flow,
-            corr_grad_stop=corr_grad_stop,
-            full_shape=(img1.shape[1], img1.shape[2]),
-            train=train,
-            frozen_bn=frozen_bn,
+        cvol = corr_mod.make_cmod(
+            self.corr_type, self.corr_channels, radius=self.corr_radius,
+            dap_init=self.dap_init, norm_type=self.mnet_norm,
+            **(self.corr_args or {}),
+        )
+        # always created (and called in the step) so a '+dap' readout's
+        # params exist regardless of the static corr_flow switch
+        reg = corr_mod.make_flow_regression(
+            self.corr_type, self.corr_reg_type, self.corr_radius,
+            **(self.corr_reg_args or {}),
+        )
+        update = BasicUpdateBlock(hdim, dtype=dt)
+        upnet8 = nn.remat(Up8Network, prevent_cse=False)(
+            dtype=dt, name="Up8Network_0")
+
+        # one (remat-wrapped) step body serves both realizations; scan
+        # unless batch norm is actually training (the lifted scan
+        # broadcasts batch_stats read-only; see raft_dicl_ctf)
+        if self.remat:
+            body = nn.remat(
+                _CtfStep, prevent_cse=False,
+                policy=jax.checkpoint_policies.save_only_these_names(
+                    "corr_features"),
+            )
+        else:
+            body = _CtfStep
+        shared = dict(
+            cmod=cvol, reg=reg, update=update, dap=dap,
+            corr_grad_stop=corr_grad_stop, train=train, frozen_bn=frozen_bn,
         )
 
-        out, out_corr = [], []
-        carry = (h, coords1)
-        for _ in range(iterations):
-            carry, (flow_up, corr_flows) = step(carry, fmap1, fmap2, x, coords0)
-            out.append(flow_up)
-            if corr_flow:
-                out_corr.append(corr_flows[0])
+        if self.unroll or (train and not frozen_bn):
+            step = body(**shared)
+            carry = (h, coords1)
+            flows, hiddens, readouts = [], [], []
+            for _ in range(iterations):
+                carry, (fl, hi, ro, _pv) = step(
+                    carry, jnp.zeros((0,)), fmap1, fmap2, x, coords0)
+                flows.append(fl)
+                hiddens.append(hi)
+                readouts.append(ro)
+            h, coords1 = carry
+
+            flows = jnp.stack(flows)
+            hiddens = jnp.stack(hiddens)
+            readouts = jnp.stack(readouts)
+        else:
+            step = nn.scan(
+                body,
+                variable_broadcast=["params", "batch_stats"],
+                split_rngs={"params": False, "dropout": True},
+                in_axes=(0, nn.broadcast, nn.broadcast, nn.broadcast,
+                         nn.broadcast),
+                out_axes=0,
+            )(**shared)
+
+            (h, coords1), (flows, hiddens, readouts, _prevs) = step(
+                (h, coords1), jnp.zeros((iterations, 0)),
+                fmap1, fmap2, x, coords0,
+            )
+
+        # convex 8x upsampling, batched over all iterations at once
+        full_shape = (img1.shape[1], img1.shape[2])
+        flows_flat = flows.reshape(iterations * b, hc, wc, 2)
+        hiddens_flat = hiddens.reshape(iterations * b, hc, wc, hdim)
+
+        ups = upnet8(hiddens_flat, flows_flat)
+        if not upnet:
+            ups = 8.0 * interpolate_bilinear(flows_flat, full_shape)
+        ups = ups.reshape(iterations, b, *full_shape, 2)
+
+        out = [ups[i] for i in range(iterations)]
 
         if corr_flow:
-            return [out_corr, out]
+            return [[readouts[i] for i in range(iterations)], out]
 
         return out
 

@@ -24,6 +24,42 @@ from .raft import BasicUpdateBlock, Up8Network, make_flow_regression
 from .raft_dicl_ctf import _DEFAULT_ITERATIONS, _PYRAMIDS
 
 
+class _SlCtfStep(nn.Module):
+    """One GRU iteration at a fixed pyramid level — the nn.scan body.
+
+    Parameterized submodules (regression, update block) are shared
+    instances from the parent scope so parameter paths are identical to
+    the unrolled loop and level sharing composes with the scan."""
+
+    reg: nn.Module
+    update: nn.Module
+    corr_radius: int
+    corr_grad_stop: bool
+
+    @nn.compact
+    def __call__(self, carry, _, pyramid, x, coords0):
+        from jax.ad_checkpoint import checkpoint_name
+
+        h, coords1 = carry
+        coords1 = jax.lax.stop_gradient(coords1)
+        flow = coords1 - coords0
+
+        corr = lookup_pyramid(pyramid, coords1, self.corr_radius)
+        corr = checkpoint_name(corr, "corr_features")
+
+        # always called so a '+dap' readout's params exist regardless of
+        # the static switch; XLA removes the unused branch
+        readout = flow + self.reg(corr)[0]
+
+        if self.corr_grad_stop:
+            corr = jax.lax.stop_gradient(corr)
+
+        h, d = self.update(h, x, corr, flow)
+        coords1 = coords1 + d
+
+        return (h, coords1), (coords1 - coords0, h, readout)
+
+
 class RaftSlCtfModule(nn.Module):
     """Coarse-to-fine RAFT over ``levels`` pyramid levels, single-level
     all-pairs correlation per level."""
@@ -42,6 +78,8 @@ class RaftSlCtfModule(nn.Module):
     corr_reg_args: dict = None
     share_rnn: bool = True
     upsample_hidden: str = "none"
+    remat: bool = True
+    unroll: bool = False
 
     @nn.compact
     def __call__(self, img1, img2, train=False, frozen_bn=False,
@@ -91,7 +129,9 @@ class RaftSlCtfModule(nn.Module):
             )
             for lvl in level_ids
         }
-        upnet8 = Up8Network()
+        # remat'd batched convex upsampler, pinned name for checkpoint
+        # stability
+        upnet8 = nn.remat(Up8Network, prevent_cse=False)(name="Up8Network_0")
 
         out = []
         flow = None
@@ -101,11 +141,11 @@ class RaftSlCtfModule(nn.Module):
             scale = 2 ** lvl
             lh, lw = h // scale, w // scale
             fine_idx = lvl - 3
+            n_iter = iterations[li]
 
             coords0 = coordinate_grid(b, lh, lw)
             if flow is None:
                 coords1 = coords0
-                flow = coords1 - coords0
             else:
                 flow = upsample_flow_2x(flow)
                 coords1 = coords0 + flow
@@ -119,38 +159,70 @@ class RaftSlCtfModule(nn.Module):
             finest = li == self.levels - 1
 
             # single-level all-pairs volume for this pyramid level
-            pyramid = [all_pairs_correlation(f1[fine_idx], f2[fine_idx])]
+            pyramid = (all_pairs_correlation(f1[fine_idx], f2[fine_idx]),)
 
-            out_lvl, out_corr = [], []
-            for _ in range(iterations[li]):
-                coords1 = jax.lax.stop_gradient(coords1)
+            # one nn.scan per level with remat — the raft/baseline
+            # iteration discipline (models/impls/raft.py:322-352); the
+            # body is batch-norm-free, so the scan covers training too
+            if self.remat:
+                body = nn.remat(
+                    _SlCtfStep, prevent_cse=False,
+                    policy=jax.checkpoint_policies.save_only_these_names(
+                        "corr_features"),
+                )
+            else:
+                body = _SlCtfStep
+            shared = dict(
+                reg=regs[lvl], update=updates[lvl],
+                corr_radius=self.corr_radius,
+                corr_grad_stop=corr_grad_stop,
+            )
 
-                corr = lookup_pyramid(pyramid, coords1, self.corr_radius)
+            if self.unroll:
+                step = body(**shared)
+                carry = (h_state, coords1)
+                flows, hiddens, readouts = [], [], []
+                for _ in range(n_iter):
+                    carry, (fl, hi, ro) = step(
+                        carry, jnp.zeros((0,)), pyramid, x, coords0)
+                    flows.append(fl)
+                    hiddens.append(hi)
+                    readouts.append(ro)
+                h_state, coords1 = carry
 
-                readouts = regs[lvl](corr)
-                if corr_flow:
-                    out_corr.append(
-                        jax.lax.stop_gradient(flow) + readouts[0])
+                flows = jnp.stack(flows)
+                hiddens = jnp.stack(hiddens)
+                readouts = jnp.stack(readouts)
+            else:
+                step = nn.scan(
+                    body,
+                    variable_broadcast="params",
+                    split_rngs={"params": False, "dropout": True},
+                    in_axes=(0, nn.broadcast, nn.broadcast, nn.broadcast),
+                    out_axes=0,
+                )(**shared)
 
-                if corr_grad_stop:
-                    corr = jax.lax.stop_gradient(corr)
+                (h_state, coords1), (flows, hiddens, readouts) = step(
+                    (h_state, coords1), jnp.zeros((n_iter, 0)),
+                    pyramid, x, coords0,
+                )
 
-                h_state, d = updates[lvl](
-                    h_state, x, corr, jax.lax.stop_gradient(flow))
+            flow = flows[-1]
 
-                coords1 = coords1 + d
-                flow = coords1 - coords0
-
-                if finest:
-                    flow_up = upnet8(h_state, flow)
-                    if not upnet:
-                        flow_up = 8.0 * interpolate_bilinear(flow, (h, w))
-                    out_lvl.append(flow_up)
-                else:
-                    out_lvl.append(flow)
+            if finest:
+                # convex 8x upsampling, batched over all iterations at once
+                flows_flat = flows.reshape(n_iter * b, lh, lw, 2)
+                hidden_flat = hiddens.reshape(n_iter * b, lh, lw, hdim)
+                ups = upnet8(hidden_flat, flows_flat)
+                if not upnet:
+                    ups = 8.0 * interpolate_bilinear(flows_flat, (h, w))
+                ups = ups.reshape(n_iter, b, h, w, 2)
+                out_lvl = [ups[i] for i in range(n_iter)]
+            else:
+                out_lvl = [flows[i] for i in range(n_iter)]
 
             if corr_flow:
-                out.append(out_corr)
+                out.append([readouts[i] for i in range(n_iter)])
             out.append(out_lvl)
 
         return out

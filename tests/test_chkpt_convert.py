@@ -4,6 +4,7 @@ lossless for princeton-vl-style RAFT state dicts."""
 import sys
 from pathlib import Path
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -15,6 +16,8 @@ import chkpt_convert  # noqa: E402
 import raft_meets_dicl_tpu.models as models  # noqa: E402
 from raft_meets_dicl_tpu.metrics.functional import tree_named_leaves  # noqa: E402
 from raft_meets_dicl_tpu.strategy.checkpoint import Checkpoint  # noqa: E402
+
+pytestmark = pytest.mark.slow
 
 
 def _fabricate_torch_state(variables):

@@ -9,6 +9,8 @@ import pytest
 import raft_meets_dicl_tpu.models as models
 from raft_meets_dicl_tpu import parallel
 
+pytestmark = pytest.mark.slow
+
 TINY = {
     "name": "tiny", "id": "tiny",
     "model": {

@@ -243,6 +243,7 @@ def _make_context(tmp_path, stages, mode="continuous", step_limit=None):
     return ctx, mgr
 
 
+@pytest.mark.slow
 def test_training_context_runs(tmp_path):
     ctx, _ = _make_context(tmp_path, [_make_stage(epochs=1)])
     ctx.run()
@@ -250,18 +251,21 @@ def test_training_context_runs(tmp_path):
     assert ctx.variables is not None
 
 
+@pytest.mark.slow
 def test_training_context_grad_accum(tmp_path):
     ctx, _ = _make_context(tmp_path, [_make_stage(epochs=1, accumulate=2)])
     ctx.run()
     assert ctx.step == 1  # 2 batches, accumulate 2 → 1 optimizer step
 
 
+@pytest.mark.slow
 def test_training_context_step_limit(tmp_path):
     ctx, _ = _make_context(tmp_path, [_make_stage(epochs=3)], step_limit=3)
     ctx.run()
     assert ctx.step == 3
 
 
+@pytest.mark.slow
 def test_checkpoint_roundtrip(tmp_path):
     ctx, mgr = _make_context(tmp_path, [_make_stage(epochs=1)])
     ctx.run()
@@ -310,6 +314,7 @@ def test_checkpoint_manager_trim(tmp_path):
     assert not (tmp_path / "m-s0_e0_b1.ckpt").exists()
 
 
+@pytest.mark.slow
 def test_training_resume_mid_stage(tmp_path):
     # train one epoch of two, checkpoint, then resume epoch 2
     ctx, mgr = _make_context(tmp_path, [_make_stage(epochs=2)], step_limit=2)

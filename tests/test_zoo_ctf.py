@@ -7,6 +7,8 @@ import numpy as np
 import pytest
 
 import raft_meets_dicl_tpu.models as models
+
+pytestmark = pytest.mark.slow
 from raft_meets_dicl_tpu.models.config import load_loss
 
 RNG = jax.random.PRNGKey(0)
@@ -262,3 +264,103 @@ def test_pool_and_rfpm_encoder_families():
                                     norm_type="batch", dropout=0)
     outs = enc.apply(enc.init(RNG, x), x)
     assert [o.shape[1] for o in outs] == [8, 4]
+
+
+def test_ctf_scan_matches_unrolled():
+    """The nn.scan iteration path computes the same function (outputs and
+    gradients) as the python-unrolled loop, with identical variables —
+    parameter paths must not depend on the loop realization."""
+    from raft_meets_dicl_tpu.models.impls.raft_dicl_ctf import (
+        RaftPlusDiclCtfModule,
+    )
+
+    kw = dict(levels=2, corr_radius=2, corr_channels=8, context_channels=16,
+              recurrent_channels=16)
+    m_scan = RaftPlusDiclCtfModule(**kw)
+    m_unroll = RaftPlusDiclCtfModule(**kw, unroll=True)
+
+    rng = np.random.default_rng(12)
+    img1 = jnp.asarray(rng.uniform(-1, 1, (1, 64, 128, 3)), jnp.float32)
+    img2 = jnp.asarray(rng.uniform(-1, 1, (1, 64, 128, 3)), jnp.float32)
+
+    v = jax.jit(
+        lambda: m_scan.init(RNG, img1, img2, iterations=(1, 1))
+    )()
+    v2 = jax.jit(
+        lambda: m_unroll.init(RNG, img1, img2, iterations=(1, 1))
+    )()
+    assert jax.tree.structure(v) == jax.tree.structure(v2)
+
+    args = dict(iterations=(2, 2), corr_flow=True, prev_flow=True)
+    o_scan = m_scan.apply(v, img1, img2, **args)
+    o_unroll = m_unroll.apply(v, img1, img2, **args)
+
+    flat_s = jax.tree.leaves(o_scan)
+    flat_u = jax.tree.leaves(o_unroll)
+    assert len(flat_s) == len(flat_u)
+    for a, b in zip(flat_s, flat_u):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-5)
+
+    def loss(variables, mod):
+        out = mod.apply(variables, img1, img2, iterations=(2, 1))
+        return sum(jnp.abs(f).mean() for lvl in out for f in lvl)
+
+    g_scan = jax.grad(lambda vv: loss(vv, m_scan))(v)
+    g_unroll = jax.grad(lambda vv: loss(vv, m_unroll))(v)
+    for (path, a), (_, b) in zip(
+        jax.tree_util.tree_flatten_with_path(g_scan)[0],
+        jax.tree_util.tree_flatten_with_path(g_unroll)[0],
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-3, err_msg=str(path))
+
+
+@pytest.mark.parametrize("which", ["ml", "sl", "sl-ctf"])
+def test_scan_matches_unrolled_variants(which):
+    """Scan and unrolled loop realizations agree for the ml/sl/sl-ctf
+    hybrids (same variables — parameter paths are loop-independent)."""
+    from raft_meets_dicl_tpu.models.impls.raft_dicl_ml import (
+        RaftPlusDiclMlModule,
+    )
+    from raft_meets_dicl_tpu.models.impls.raft_dicl_sl import (
+        RaftPlusDiclModule as SlModule,
+    )
+    from raft_meets_dicl_tpu.models.impls.raft_sl_ctf import RaftSlCtfModule
+
+    rng = np.random.default_rng(21)
+
+    if which == "ml":
+        kw = dict(corr_levels=2, corr_radius=2, corr_channels=8,
+                  context_channels=16, recurrent_channels=16)
+        mods = (RaftPlusDiclMlModule(**kw),
+                RaftPlusDiclMlModule(**kw, unroll=True))
+        args = dict(iterations=2, corr_flow=True)
+        shape = (1, 64, 96, 3)
+    elif which == "sl":
+        kw = dict(corr_radius=2, corr_channels=8, context_channels=16,
+                  recurrent_channels=16)
+        mods = (SlModule(**kw), SlModule(**kw, unroll=True))
+        args = dict(iterations=2, corr_flow=True)
+        shape = (1, 64, 96, 3)
+    else:
+        kw = dict(levels=2, corr_radius=2, corr_channels=16,
+                  context_channels=16, recurrent_channels=16)
+        mods = (RaftSlCtfModule(**kw), RaftSlCtfModule(**kw, unroll=True))
+        args = dict(iterations=(2, 2), corr_flow=True)
+        shape = (1, 64, 128, 3)
+
+    img1 = jnp.asarray(rng.uniform(-1, 1, shape), jnp.float32)
+    img2 = jnp.asarray(rng.uniform(-1, 1, shape), jnp.float32)
+
+    init_iters = (dict(iterations=(1, 1))
+                  if which == "sl-ctf" else dict(iterations=1))
+    v = jax.jit(lambda: mods[0].init(RNG, img1, img2, **init_iters))()
+    v2 = jax.jit(lambda: mods[1].init(RNG, img1, img2, **init_iters))()
+    assert jax.tree.structure(v) == jax.tree.structure(v2)
+
+    o_scan = mods[0].apply(v, img1, img2, **args)
+    o_unroll = mods[1].apply(v, img1, img2, **args)
+    for a, b in zip(jax.tree.leaves(o_scan), jax.tree.leaves(o_unroll)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-5)

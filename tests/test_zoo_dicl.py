@@ -7,6 +7,8 @@ import numpy as np
 import pytest
 
 import raft_meets_dicl_tpu.models as models
+
+pytestmark = pytest.mark.slow
 from raft_meets_dicl_tpu.models.common import corr, encoders
 from raft_meets_dicl_tpu.models.impls.dicl import (
     displaced_pair_volume,

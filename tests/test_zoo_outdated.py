@@ -4,7 +4,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import pytest
+
 import raft_meets_dicl_tpu.models as models
+
+pytestmark = pytest.mark.slow
 from raft_meets_dicl_tpu.models.config import load_loss, load_model
 
 RNG = jax.random.PRNGKey(0)
