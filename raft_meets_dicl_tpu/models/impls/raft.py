@@ -30,11 +30,11 @@ from ...ops.corr import (
     lookup_pyramid,
     window_delta,
 )
+from ...ops.upsample import convex_upsample_8x
 from .. import common
 from ..common.blocks.dicl import DisplacementAwareProjection
 from ..common.grid import coordinate_grid
 from ..common.hsup import upsample2d_bilinear
-from ..common.util import unfold3x3
 from ..config import register_loss, register_model
 from ..model import Loss, Model, ModelAdapter, Result
 
@@ -158,13 +158,15 @@ class BasicUpdateBlock(nn.Module):
 class Up8Network(nn.Module):
     """Convex 8x upsampling: per-pixel softmax over 3x3 coarse neighbors.
 
-    The contraction is shaped to keep intermediates compact: the softmax
-    weights stay (B, h, w, 64, 9) — subpixel-major, so the softmax reduces
-    over the trailing contiguous axis — and the neighbor sum produces
-    (B, h, w, 64, 2) with one pixel-shuffle transpose at the end. (A
-    direct 6-axis einsum to the interleaved layout makes XLA materialize
-    f32 (B, h, w, 9, 8, 8) tensors with layout copies — profiled as the
-    single largest cost of the training step.)
+    Mask channels are neighbor-major (k, sub-row, sub-col) — torch RAFT's
+    native layout (``view(b, 1, 9, 8, 8, h, w)``), so converted
+    checkpoints import without a channel permutation. The softmax +
+    convex combine run as the fused Pallas kernel
+    (``ops.pallas.convex_combine_8x``) on TPU — the XLA-scheduled form
+    materialized ~750 MB/step of f32 mask intermediates with layout
+    copies at the bench config, the single largest cost of the training
+    step. The flow window stays f32 throughout: it IS the model output,
+    and bf16 ulp at 8·flow magnitudes is ~px-scale.
     """
 
     temperature: float = 4.0  # 4.0 = 1.0/0.25 in original RAFT
@@ -172,31 +174,10 @@ class Up8Network(nn.Module):
 
     @nn.compact
     def __call__(self, hidden, flow):
-        b, h, w, c = flow.shape
-
-        # mask channels are ordered (subpixel, neighbor) — the softmax then
-        # reduces over the *trailing, contiguous* axis (the reference's
-        # (neighbor, subpixel) order makes XLA transpose-copy the 37MB f32
-        # mask around the softmax; the torch-checkpoint importer permutes)
         mask = nn.Conv(256, (3, 3), dtype=self.dtype)(hidden)
         mask = nn.relu(mask)
         mask = nn.Conv(8 * 8 * 9, (1, 1), dtype=self.dtype)(mask)
-        mask = mask.reshape(b, h, w, 8 * 8, 9).astype(jnp.float32)
-        mask = jax.nn.softmax(mask / self.temperature, axis=-1)
-
-        win = unfold3x3(8.0 * flow)  # (B, h, w, 9, 2)
-
-        if self.dtype is not None:
-            # only the mask rides in reduced precision (convex weights in
-            # [0, 1], benign); the flow window stays f32 — it IS the model
-            # output, and bf16 ulp at 8·flow magnitudes is ~px-scale
-            mask = mask.astype(self.dtype)
-
-        up = jnp.einsum("bhwsk,bhwkc->bhwsc", mask, win,
-                        preferred_element_type=jnp.float32)
-        up = up.reshape(b, h, w, 8, 8, c)
-        up = up.transpose(0, 1, 3, 2, 4, 5)  # (B, h, 8, w, 8, C)
-        return up.reshape(b, h * 8, w * 8, c)
+        return convex_upsample_8x(flow, mask, temperature=self.temperature)
 
 
 class _RaftStep(nn.Module):
@@ -304,12 +285,17 @@ class RaftModule(nn.Module):
             fmap1 = fmap1.astype(jnp.float32)
             fmap2 = fmap2.astype(jnp.float32)
 
+        # The all-pairs volume + einsum windowed lookup is the FASTEST
+        # measured realization on-chip at training crops (the feature-space
+        # alternative — ops.pallas.windowed_corr_pyramid, identical math by
+        # linearity of pooling/interp in f2 — is what raft/fs uses where
+        # the O(H²W²) volume cannot exist at all)
         corr_full = all_pairs_correlation(fmap1, fmap2)
         if dt is not None:
             # keep the O(H²W²) volume in bf16: halves HBM footprint and
             # lookup read traffic; the lookup einsums accumulate in f32
             corr_full = corr_full.astype(dt)
-        pyramid = correlation_pyramid(corr_full, self.corr_levels)
+        pyramid = tuple(correlation_pyramid(corr_full, self.corr_levels))
 
         ctx = cnet(img1, train, frozen_bn)
         h = jnp.tanh(ctx[..., :hdim])
@@ -352,7 +338,7 @@ class RaftModule(nn.Module):
         )
 
         (h, coords1), (flows, hiddens, corr_flows) = step(
-            (h, coords1), tuple(pyramid), x, coords0
+            (h, coords1), pyramid, x, coords0
         )
 
         # convex 8x upsampling, batched over all iterations at once (one

@@ -17,7 +17,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from ...ops.corr import windowed_correlation
+from ...ops.pallas import windowed_corr_pyramid
 from ...ops.pool import avg_pool2d
 from ...ops.upsample import interpolate_bilinear
 from ..common import encoders
@@ -44,18 +44,14 @@ class _FsStep(nn.Module):
         coords1 = jax.lax.stop_gradient(coords1)
         flow = coords1 - coords0
 
-        # on-the-fly windowed dot-product per pyramid level; the reference
-        # lookup skips the sqrt(C) normalization (raft_fs.py:76)
-        corr = []
-        for i, f2 in enumerate(pyramid):
-            level = windowed_correlation(
-                fmap1, f2, coords1, self.corr_radius, scale=float(2 ** i),
-                normalize=False,
-            )
-            if i + 3 in self.mask_costs:
-                level = jnp.zeros_like(level)
-            corr.append(level)
-        corr = jnp.concatenate(corr, axis=-1)
+        # on-the-fly windowed dot-product against the pooled pyramid — the
+        # fused kernel (ops/pallas.py) on TPU, per-level windowed
+        # correlation off it; the reference lookup skips the sqrt(C)
+        # normalization (raft_fs.py:76)
+        corr = windowed_corr_pyramid(
+            fmap1, pyramid, coords1, self.corr_radius,
+            mask_costs=self.mask_costs, normalize=False,
+        )
 
         h, d = BasicUpdateBlock(self.recurrent_channels, dtype=self.dtype)(
             h, x, corr, flow)

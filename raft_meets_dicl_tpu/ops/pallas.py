@@ -1,0 +1,567 @@
+"""Pallas TPU kernels for profiled hot paths.
+
+The XLA-composite ops in this package are the default implementation;
+kernels here replace the ones where profiling on real hardware showed the
+compiler-scheduled form paying large materialization/layout costs.
+
+``convex_combine_8x`` — the RAFT convex-upsampling mask combine
+(reference Up8Network core, src/models/impls/raft.py:313-331). The
+XLA form (softmax + einsum over a (N, h, w, 64, 9) mask) materializes
+~750 MB of f32 intermediates with layout copies per training step at the
+bench config (batch 6, 400x720, 12 iterations — the mask is built for
+all iterations at once); profiled at ~70 ms/step of the 425 ms total.
+The kernel fuses softmax and combine per row tile: only the 576-channel
+logits are read and the 128-channel result written, nothing else touches
+HBM. Forward and backward are both Pallas; the VJP recomputes the
+softmax from the saved logits instead of storing probabilities.
+
+Layout contract (matches torch RAFT's ``view(b, 1, 9, 8, 8, h, w)``):
+logits channels are neighbor-major ``k * 64 + s`` (k = 3x3 neighbor
+row-major, s = subpixel ``r * 8 + c``); outputs are ``chan * 64 + s``.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_TILE = 512
+_K = 9       # 3x3 neighbors
+_S = 64      # 8x8 subpixels
+_C = 2       # flow channels
+
+
+def _softmax_slices(logits, inv_temp):
+    """Grouped softmax over the 9 neighbor blocks of (T, 576) logits,
+    returned as unnormalized exps + reciprocal of the partition sum —
+    column-slice arithmetic only (no reshapes: Mosaic-friendly)."""
+    xs = [logits[:, _S * k: _S * (k + 1)] * inv_temp for k in range(_K)]
+    m = xs[0]
+    for k in range(1, _K):
+        m = jnp.maximum(m, xs[k])
+    es = [jnp.exp(x - m) for x in xs]
+    denom = es[0]
+    for k in range(1, _K):
+        denom = denom + es[k]
+    return es, 1.0 / denom
+
+
+def _fwd_kernel(logits_ref, win_ref, out_ref, *, inv_temp):
+    x = logits_ref[:].astype(jnp.float32)   # (T, 576)
+    w = win_ref[:].astype(jnp.float32)      # (T, 18), layout k*2 + c
+
+    es, inv = _softmax_slices(x, inv_temp)
+
+    acc0 = es[0] * w[:, 0:1]
+    acc1 = es[0] * w[:, 1:2]
+    for k in range(1, _K):
+        acc0 = acc0 + es[k] * w[:, 2 * k: 2 * k + 1]
+        acc1 = acc1 + es[k] * w[:, 2 * k + 1: 2 * k + 2]
+
+    out_ref[:, 0:_S] = acc0 * inv
+    out_ref[:, _S: 2 * _S] = acc1 * inv
+
+
+def _bwd_kernel(logits_ref, win_ref, dout_ref, dlogits_ref, dwin_ref, *,
+                inv_temp):
+    x = logits_ref[:].astype(jnp.float32)
+    w = win_ref[:].astype(jnp.float32)
+    d0 = dout_ref[:, 0:_S]
+    d1 = dout_ref[:, _S: 2 * _S]
+
+    es, inv = _softmax_slices(x, inv_temp)
+
+    ps, dps, dwin_cols = [], [], []
+    s_acc = None
+    for k in range(_K):
+        p_k = es[k] * inv
+        dp_k = d0 * w[:, 2 * k: 2 * k + 1] + d1 * w[:, 2 * k + 1: 2 * k + 2]
+        dwin_cols.append(jnp.sum(p_k * d0, axis=1, keepdims=True))
+        dwin_cols.append(jnp.sum(p_k * d1, axis=1, keepdims=True))
+        term = p_k * dp_k
+        s_acc = term if s_acc is None else s_acc + term  # Σ_k p_k·dp_k
+        ps.append(p_k)
+        dps.append(dp_k)
+
+    dl = [ps[k] * (dps[k] - s_acc) * inv_temp for k in range(_K)]
+    dlogits_ref[:] = jnp.concatenate(dl, axis=1).astype(dlogits_ref.dtype)
+    dwin_ref[:] = jnp.concatenate(dwin_cols, axis=1)
+
+
+def _pad_rows(x, tile):
+    m = x.shape[0]
+    pad = (-m) % tile
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+    return x, m
+
+
+def _run_fwd(logits2d, win2d, inv_temp, interpret=False):
+    logits2d, m = _pad_rows(logits2d, _TILE)
+    win2d, _ = _pad_rows(win2d, _TILE)
+    grid = (logits2d.shape[0] // _TILE,)
+
+    out = pl.pallas_call(
+        functools.partial(_fwd_kernel, inv_temp=inv_temp),
+        out_shape=jax.ShapeDtypeStruct((logits2d.shape[0], _C * _S),
+                                       jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_TILE, _K * _S), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((_TILE, _K * _C), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((_TILE, _C * _S), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(logits2d, win2d)
+    return out[:m]
+
+
+def _run_bwd(logits2d, win2d, dout2d, inv_temp, interpret=False):
+    logits2d, m = _pad_rows(logits2d, _TILE)
+    win2d, _ = _pad_rows(win2d, _TILE)
+    dout2d, _ = _pad_rows(dout2d, _TILE)
+    grid = (logits2d.shape[0] // _TILE,)
+
+    dlogits, dwin = pl.pallas_call(
+        functools.partial(_bwd_kernel, inv_temp=inv_temp),
+        out_shape=(
+            jax.ShapeDtypeStruct(logits2d.shape, logits2d.dtype),
+            jax.ShapeDtypeStruct(win2d.shape, jnp.float32),
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_TILE, _K * _S), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((_TILE, _K * _C), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((_TILE, _C * _S), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((_TILE, _K * _S), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((_TILE, _K * _C), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ),
+        interpret=interpret,
+    )(logits2d, win2d, dout2d)
+    return dlogits[:m], dwin[:m]
+
+
+def _run_fwd_interpret(logits2d, win2d, inv_temp):
+    """Interpreter-mode forward (kernel correctness tests off-TPU)."""
+    return _run_fwd(logits2d, win2d, inv_temp, interpret=True)
+
+
+def _run_bwd_interpret(logits2d, win2d, dout2d, inv_temp):
+    """Interpreter-mode backward (kernel correctness tests off-TPU)."""
+    return _run_bwd(logits2d, win2d, dout2d, inv_temp, interpret=True)
+
+
+def _combine_reference(logits2d, win2d, inv_temp):
+    """XLA fallback with identical semantics (used off-TPU and as the
+    numerical reference in tests)."""
+    x = logits2d.astype(jnp.float32).reshape(-1, _K, _S) * inv_temp
+    p = jax.nn.softmax(x, axis=1)                      # (M, 9, 64)
+    w = win2d.astype(jnp.float32).reshape(-1, _K, _C)  # (M, 9, 2)
+    out = jnp.einsum("mks,mkc->mcs", p, w)
+    return out.reshape(-1, _C * _S)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _combine(logits2d, win2d, inv_temp):
+    if jax.default_backend() == "tpu":
+        return _run_fwd(logits2d, win2d, inv_temp)
+    return _combine_reference(logits2d, win2d, inv_temp)
+
+
+def _combine_fwd(logits2d, win2d, inv_temp):
+    return _combine(logits2d, win2d, inv_temp), (logits2d, win2d)
+
+
+def _combine_bwd(inv_temp, res, dout):
+    logits2d, win2d = res
+    if jax.default_backend() == "tpu":
+        dlogits, dwin = _run_bwd(logits2d, win2d, dout, inv_temp)
+        return dlogits, dwin
+
+    def f(lg, wn):
+        return _combine_reference(lg, wn, inv_temp)
+
+    _, vjp = jax.vjp(f, logits2d, win2d)
+    dlogits, dwin = vjp(dout.astype(jnp.float32))
+    return dlogits.astype(logits2d.dtype), dwin.astype(jnp.float32)
+
+
+_combine.defvjp(_combine_fwd, _combine_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Fused windowed correlation over a feature pyramid.
+#
+# Mathematical identity with the RAFT all-pairs volume path: pooling and
+# bilinear interpolation are both linear in f2, so
+#     lookup(pyramid(all_pairs(f1, f2)), coords)
+#   = windowed_correlation(f1, avg_pool^l(f2), coords / 2^l)   per level.
+# The kernel computes the right-hand side directly — the O(H²W²) volume is
+# never materialized, its pyramid never built, and the backward pass
+# accumulates into the (tiny) pooled feature maps instead of carrying
+# volume-sized gradients through the iteration scan. This is also the
+# long-spatial-context kernel (SURVEY §5.7): memory is O(B·H·W·C)
+# regardless of resolution, which is what makes 1080p training fit.
+#
+# Channel order of the output: (level, dx, dy) — identical to
+# ops.corr.lookup_pyramid and the reference CorrBlock (raft.py:57-92).
+
+# The slab's x-start is rounded down to a multiple of 8 (Mosaic requires
+# statically-provable sublane alignment for dynamic slices); the kernel
+# reads a widened 8-aligned slab and folds the residual shift s = x0 - x8
+# into a small per-position selection matrix built from iotas. _XW is the
+# widened slab width: ceil((k+1) + 7, 8) for r=4 → 24.
+_XW = 24
+
+
+def _wcp_pads(radius):
+    """(lo, hi_y, hi_x) zero-padding of the f2 maps so every clamped,
+    8-aligned window is a plain in-bounds slice: x-starts lie in
+    [0, lo + dim] after clamping centers to [-(r+1), dim+r], and the
+    widened slab extends _XW past the start."""
+    lo = 2 * radius + 1
+    return lo, 2 * radius + 2, _XW
+
+
+def _wcp_window(cx, cy, lvl, dim_h, dim_w, radius):
+    """Clamped window start indices (into the padded map), the 8-aligned
+    x-start + residual shift, and the bilinear fractions."""
+    scale = float(2 ** lvl)
+    r = radius
+    cx = cx / scale
+    cy = cy / scale
+    # centers whose whole window is out of bounds clamp to positions whose
+    # sampled values are all zero (padding) — grid_sample zero semantics
+    cx = jnp.clip(cx, -(r + 1.0), dim_w - 1.0 + r + 1.0)
+    cy = jnp.clip(cy, -(r + 1.0), dim_h - 1.0 + r + 1.0)
+    x0f = jnp.floor(cx)
+    y0f = jnp.floor(cy)
+    lo = 2 * r + 1
+    x0 = x0f.astype(jnp.int32) - r + lo
+    y0 = y0f.astype(jnp.int32) - r + lo
+    x8 = pl.multiple_of((x0 // 8) * 8, 8)
+    return x8, x0 - x8, y0, cx - x0f, cy - y0f
+
+
+def _x_select(s, fx, k):
+    """(_XW, k) selection-and-lerp matrix: column dx picks lanes s+dx and
+    s+dx+1 with the bilinear weights — the dynamic lane shift expressed as
+    arithmetic instead of an (unsupported) dynamic lane slice."""
+    ix = jax.lax.broadcasted_iota(jnp.int32, (_XW, k), 0)
+    dxi = jax.lax.broadcasted_iota(jnp.int32, (_XW, k), 1)
+    return (jnp.where(ix == dxi + s, 1.0 - fx, 0.0)
+            + jnp.where(ix == dxi + s + 1, fx, 0.0))
+
+
+def _wcp_fwd_kernel(coords_ref, f1_ref, *f2_refs_and_out, radius, dims):
+    f2_refs = f2_refs_and_out[:-1]
+    out_ref = f2_refs_and_out[-1]
+    k = 2 * radius + 1
+    kk = k * k
+    n_j = f1_ref.shape[2]
+
+    def body(j, _):
+        f1j = f1_ref[0, 0, j].astype(jnp.float32)      # (1, C)
+        cx = coords_ref[0, 0, j, 0]
+        cy = coords_ref[0, 0, j, 1]
+        for lvl, f2_ref in enumerate(f2_refs):
+            h2, w2 = dims[lvl]
+            x8, s, y0, fx, fy = _wcp_window(cx, cy, lvl, h2, w2, radius)
+
+            slab = f2_ref[0, pl.ds(y0, k + 1), pl.ds(x8, _XW), :]
+            d = jnp.sum(slab.astype(jnp.float32) * f1j[None, :, :],
+                        axis=-1)                       # (k+1, _XW): (y, x)
+            t = (1.0 - fy) * d[0:k, :] + fy * d[1:k + 1, :]   # (k, _XW)
+            m = _x_select(s, fx, k)                           # (_XW, k)
+            v = jnp.sum(t[:, :, None] * m[None, :, :], axis=1)  # (dy, dx)
+            vt = v.T                                            # (dx, dy)
+            out_ref[0, 0, j, lvl * k:(lvl + 1) * k, :] = vt
+        return 0
+
+    jax.lax.fori_loop(0, n_j, body, 0)
+
+
+def _unlerp(dout_ref, j, lvl, s, fx, fy, radius):
+    """Transpose of the window lerps: spread the (dy, dx) cost gradient of
+    position j at level lvl onto the widened (k+1, _XW) slab."""
+    k = 2 * radius + 1
+    dv = dout_ref[0, 0, j, lvl * k:(lvl + 1) * k, :].T  # (dy, dx)
+    m = _x_select(s, fx, k)                             # (_XW, k)
+    dt = jnp.sum(dv[:, None, :] * m[None, :, :], axis=2)  # (k, _XW)
+    zr = jnp.zeros((1, _XW), jnp.float32)
+    return ((1.0 - fy) * jnp.concatenate([dt, zr], axis=0)
+            + fy * jnp.concatenate([zr, dt], axis=0))     # (k+1, _XW)
+
+
+def _wcp_bwd_df1_kernel(coords_ref, dout_ref, *f2_refs_and_out, radius,
+                        dims):
+    """df1 over all levels (reads the f2 maps, touches no df2 state —
+    split from the df2 kernel so each stays under the VMEM budget)."""
+    f2_refs = f2_refs_and_out[:-1]
+    df1_ref = f2_refs_and_out[-1]
+    k = 2 * radius + 1
+    n_j = df1_ref.shape[2]
+
+    def body(j, _):
+        cx = coords_ref[0, 0, j, 0]
+        cy = coords_ref[0, 0, j, 1]
+        acc = None
+        for lvl, f2_ref in enumerate(f2_refs):
+            h2, w2 = dims[lvl]
+            x8, s, y0, fx, fy = _wcp_window(cx, cy, lvl, h2, w2, radius)
+            dd = _unlerp(dout_ref, j, lvl, s, fx, fy, radius)
+
+            slab = f2_ref[0, pl.ds(y0, k + 1), pl.ds(x8, _XW), :]
+            part = jnp.sum(dd[:, :, None] * slab.astype(jnp.float32), axis=0)
+            part = jnp.sum(part, axis=0, keepdims=True)   # (1, C)
+            acc = part if acc is None else acc + part
+        df1_ref[0, 0, j] = acc
+        return 0
+
+    jax.lax.fori_loop(0, n_j, body, 0)
+
+
+def _wcp_bwd_df2_kernel(coords_ref, f1_ref, dout_ref, df2_ref, *, radius,
+                        lvl, dims):
+    """df2 for ONE pyramid level, accumulated across the i-grid (the
+    output block is indexed by b only and stays resident in VMEM).
+    ``dout_ref`` carries only this level's (k, k) channel block."""
+    k = 2 * radius + 1
+    n_j = f1_ref.shape[2]
+    h2, w2 = dims
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _():
+        df2_ref[:] = jnp.zeros_like(df2_ref)
+
+    def body(j, _):
+        f1j = f1_ref[0, 0, j].astype(jnp.float32)      # (1, C)
+        cx = coords_ref[0, 0, j, 0]
+        cy = coords_ref[0, 0, j, 1]
+        x8, s, y0, fx, fy = _wcp_window(cx, cy, lvl, h2, w2, radius)
+        dd = _unlerp(dout_ref, j, 0, s, fx, fy, radius)
+
+        df2_ref[0, pl.ds(y0, k + 1), pl.ds(x8, _XW), :] += (
+            dd[:, :, None] * f1j[None, :, :])
+        return 0
+
+    jax.lax.fori_loop(0, n_j, body, 0)
+
+
+def _wcp_pad_f2(f2_levels, radius):
+    lo, hi_y, hi_x = _wcp_pads(radius)
+    return tuple(
+        jnp.pad(f2, ((0, 0), (lo, hi_y), (lo, hi_x), (0, 0)))
+        for f2 in f2_levels
+    )
+
+
+def _wcp_fwd_interpret(f1, f2_levels, coords, radius):
+    """Interpreter-mode forward (kernel correctness tests off-TPU)."""
+    return _wcp_fwd_tpu(f1, tuple(f2_levels), coords, radius, interpret=True)
+
+
+def _wcp_bwd_interpret(f1, f2_levels, coords, dout, radius):
+    """Interpreter-mode backward (kernel correctness tests off-TPU)."""
+    return _wcp_bwd_tpu(f1, tuple(f2_levels), coords, dout, radius,
+                        interpret=True)
+
+
+def _wcp_fwd_tpu(f1, f2_levels, coords, radius, interpret=False):
+    b, n_i, n_j, c = f1.shape
+    k = 2 * radius + 1
+    n_lvl = len(f2_levels)
+    dims = tuple((f2.shape[1], f2.shape[2]) for f2 in f2_levels)
+    f2p = _wcp_pad_f2(f2_levels, radius)
+
+    # j rides an untiled axis (the dummy sublane dim keeps the last-two
+    # dims static so per-position dynamic indexing is legal)
+    f1r = f1.reshape(b, n_i, n_j, 1, c)
+
+    kernel = functools.partial(_wcp_fwd_kernel, radius=radius, dims=dims)
+
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, n_i, n_j, n_lvl * k, k),
+                                       jnp.float32),
+        grid=(b, n_i),
+        in_specs=[
+            pl.BlockSpec((1, 1, n_j, 2), lambda bi, ii: (bi, ii, 0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, n_j, 1, c), lambda bi, ii: (bi, ii, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ] + [
+            pl.BlockSpec((1,) + f2.shape[1:], lambda bi, ii: (bi, 0, 0, 0),
+                         memory_space=pltpu.VMEM)
+            for f2 in f2p
+        ],
+        out_specs=pl.BlockSpec((1, 1, n_j, n_lvl * k, k),
+                               lambda bi, ii: (bi, ii, 0, 0, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(coords, f1r, *f2p)
+    # (level, dx, dy) channel flatten — (L*k, k) row-major is exactly that
+    return out.reshape(b, n_i, n_j, n_lvl * k * k)
+
+
+def _wcp_bwd_tpu(f1, f2_levels, coords, dout, radius, interpret=False):
+    b, n_i, n_j, c = f1.shape
+    lo, _hi_y, _hi_x = _wcp_pads(radius)
+    f2p = _wcp_pad_f2(f2_levels, radius)
+    dims = tuple((f2.shape[1], f2.shape[2]) for f2 in f2_levels)
+
+    k = 2 * radius + 1
+    n_lvl = len(f2_levels)
+    f1r = f1.reshape(b, n_i, n_j, 1, c)
+    doutr = dout.reshape(b, n_i, n_j, n_lvl * k, k)
+
+    coords_spec = pl.BlockSpec((1, 1, n_j, 2), lambda bi, ii: (bi, ii, 0, 0),
+                               memory_space=pltpu.SMEM)
+    dout_spec = pl.BlockSpec((1, 1, n_j, n_lvl * k, k),
+                             lambda bi, ii: (bi, ii, 0, 0, 0),
+                             memory_space=pltpu.VMEM)
+    row_spec = pl.BlockSpec((1, 1, n_j, 1, c),
+                            lambda bi, ii: (bi, ii, 0, 0, 0),
+                            memory_space=pltpu.VMEM)
+
+    df1 = pl.pallas_call(
+        functools.partial(_wcp_bwd_df1_kernel, radius=radius, dims=dims),
+        out_shape=jax.ShapeDtypeStruct((b, n_i, n_j, 1, c), jnp.float32),
+        grid=(b, n_i),
+        in_specs=[coords_spec, dout_spec] + [
+            pl.BlockSpec((1,) + f2.shape[1:], lambda bi, ii: (bi, 0, 0, 0),
+                         memory_space=pltpu.VMEM)
+            for f2 in f2p
+        ],
+        out_specs=row_spec,
+        interpret=interpret,
+    )(coords, doutr, *f2p).reshape(b, n_i, n_j, c)
+
+    df2_out = []
+    for lvl, f2 in enumerate(f2p):
+        # pass only this level's dout columns; raise the scoped-vmem cap —
+        # the accumulated df2 block (revisited across the i-grid) plus its
+        # pipeline double-buffer exceed the default budget at level 0
+        dout_l = doutr[:, :, :, lvl * k:(lvl + 1) * k, :]
+        dout_l_spec = pl.BlockSpec((1, 1, n_j, k, k),
+                                   lambda bi, ii: (bi, ii, 0, 0, 0),
+                                   memory_space=pltpu.VMEM)
+        df2_l = pl.pallas_call(
+            functools.partial(_wcp_bwd_df2_kernel, radius=radius, lvl=lvl,
+                              dims=dims[lvl]),
+            out_shape=jax.ShapeDtypeStruct(f2.shape, jnp.float32),
+            grid=(b, n_i),
+            in_specs=[coords_spec, row_spec, dout_l_spec],
+            out_specs=pl.BlockSpec((1,) + f2.shape[1:],
+                                   lambda bi, ii: (bi, 0, 0, 0),
+                                   memory_space=pltpu.VMEM),
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=64 * 1024 * 1024),
+            interpret=interpret,
+        )(coords, f1r, dout_l)
+
+        # strip the padding back off
+        h2, w2 = dims[lvl]
+        df2_out.append(df2_l[:, lo:lo + h2, lo:lo + w2, :])
+
+    return df1, tuple(df2_out)
+
+
+def _wcp_reference(f1, f2_levels, coords, radius):
+    """XLA fallback: per-level windowed correlation (exact same math)."""
+    from .corr import windowed_correlation
+
+    out = [
+        windowed_correlation(f1, f2, coords, radius, float(2 ** lvl),
+                             normalize=False)
+        for lvl, f2 in enumerate(f2_levels)
+    ]
+    return jnp.concatenate(out, axis=-1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _wcp(f1, f2_levels, coords, radius):
+    if jax.default_backend() == "tpu":
+        return _wcp_fwd_tpu(f1, f2_levels, coords, radius)
+    return _wcp_reference(f1, f2_levels, coords, radius)
+
+
+def _wcp_vjp_fwd(f1, f2_levels, coords, radius):
+    return _wcp(f1, f2_levels, coords, radius), (f1, f2_levels, coords)
+
+
+def _wcp_vjp_bwd(radius, res, dout):
+    f1, f2_levels, coords = res
+    if jax.default_backend() == "tpu":
+        df1, df2 = _wcp_bwd_tpu(f1, f2_levels, coords, dout, radius)
+    else:
+        def f(f1_, f2_):
+            return _wcp_reference(f1_, f2_, coords, radius)
+
+        _, vjp = jax.vjp(f, f1, f2_levels)
+        df1, df2 = vjp(dout)
+    df1 = df1.astype(f1.dtype)
+    df2 = tuple(g.astype(f2.dtype) for g, f2 in zip(df2, f2_levels))
+    # coords are stop_gradient'ed by every caller (the RAFT iteration
+    # detaches them); returning zeros keeps the vjp total
+    return df1, df2, jnp.zeros_like(coords)
+
+
+_wcp.defvjp(_wcp_vjp_fwd, _wcp_vjp_bwd)
+
+
+def windowed_corr_pyramid(f1, f2_levels, coords, radius=4, mask_costs=(),
+                          normalize=True):
+    """Fused multi-level windowed correlation (B, H, W, L·(2r+1)²).
+
+    f1: (B, H, W, C) frame-1 features; f2_levels: tuple of frame-2 feature
+    maps, level l at 1/2^l of f1's resolution (level 0 same-res); coords:
+    (B, H, W, 2) level-0 window centers. Output channels are ordered
+    (level, dx, dy) and normalized by sqrt(C) — drop-in identical to
+    ``lookup_pyramid(correlation_pyramid(all_pairs_correlation(f1, f2)))``
+    without ever building the volume. ``mask_costs`` zeroes whole levels
+    by pyramid level id (l + 3), like the reference (raft.py:86).
+    """
+    c = f1.shape[-1]
+    k = 2 * radius + 1
+    if normalize:
+        f1 = (f1 / jnp.sqrt(jnp.asarray(c, jnp.float32))).astype(f1.dtype)
+
+    out = _wcp(f1, tuple(f2_levels), coords, radius)
+
+    if mask_costs:
+        keep = jnp.concatenate([
+            jnp.full((k * k,), 0.0 if lvl + 3 in mask_costs else 1.0,
+                     jnp.float32)
+            for lvl in range(len(f2_levels))
+        ])
+        out = out * keep
+    return out
+
+
+def convex_combine_8x(mask_logits, win, temperature=4.0):
+    """Fused softmax-over-neighbors + convex combine.
+
+    mask_logits: (..., 576), channels neighbor-major ``k * 64 + s``
+    (torch RAFT's native layout). win: (..., 9, 2) float32 neighbor flow
+    windows. Returns (..., 128) float32, channels ``chan * 64 + s`` —
+    reshape to (..., 2, 8, 8) and pixel-shuffle for the upsampled flow.
+    """
+    lead = mask_logits.shape[:-1]
+    logits2d = mask_logits.reshape(-1, _K * _S)
+    win2d = win.astype(jnp.float32).reshape(-1, _K * _C)
+    out = _combine(logits2d, win2d, 1.0 / temperature)
+    return out.reshape(*lead, _C * _S)

@@ -34,14 +34,25 @@ def convex_upsample_8x(flow, mask_logits, temperature=4.0, factor=8):
     of the reference's ``view(batch, 1, 9, 8, 8, h, w)``. Returns
     (B, H*factor, W*factor, 2). The flow is scaled by ``factor`` (coarse-grid
     displacements to fine-grid displacements).
+
+    The softmax + combine is the fused kernel ``ops.pallas.convex_combine_8x``
+    on TPU (factor 8 only); only the pixel shuffle runs in XLA.
     """
     b, h, w, c = flow.shape
     f = factor
 
+    nbrs = _neighbors3x3(f * flow)  # (B, H, W, 9, 2)
+
+    if f == 8:
+        from .pallas import convex_combine_8x
+
+        up = convex_combine_8x(mask_logits, nbrs, temperature)
+        up = up.reshape(b, h, w, c, f, f)
+        up = up.transpose(0, 1, 4, 2, 5, 3)  # (B, H, r, W, s, C)
+        return up.reshape(b, h * f, w * f, c)
+
     mask = mask_logits.reshape(b, h, w, 9, f, f)
     mask = jax.nn.softmax(mask / temperature, axis=3)
-
-    nbrs = _neighbors3x3(f * flow)  # (B, H, W, 9, 2)
     up = jnp.einsum("bhwkrs,bhwkc->bhrwsc", mask, nbrs)
     return up.reshape(b, h * f, w * f, c)
 

@@ -177,16 +177,6 @@ def _make_checkpoint(model_id, filled, metadata):
     )
 
 
-def _permute_mask_head(filled):
-    """The flax Up8 mask head orders its 576 output channels
-    (subpixel, neighbor) — torch RAFT orders them (neighbor, subpixel);
-    permute so the imported weights read out identically."""
-    perm = np.argsort([s * 9 + k for k in range(9) for s in range(64)])
-    head = filled["params"]["Up8Network_0"]["Conv_1"]
-    head["kernel"] = head["kernel"][..., perm]
-    head["bias"] = head["bias"][perm]
-
-
 def convert_raft(torch_state, metadata):
     """princeton-vl RAFT (or reference raft/baseline) → ``raft/baseline``."""
     import jax
@@ -206,8 +196,6 @@ def convert_raft(torch_state, metadata):
     filled, unused = _fill_variables(variables, state, _raft_rules())
     if unused:
         logging.warning(f"unused torch keys: {sorted(unused)}")
-
-    _permute_mask_head(filled)
 
     return _make_checkpoint("raft/baseline", filled, metadata)
 
@@ -513,8 +501,6 @@ def convert_raft_dicl(torch_state, metadata):
         _ctf_rules(levels, share_dicl, share_rnn, upsample_hidden))
     if unused:
         logging.warning(f"unused torch keys: {sorted(unused)}")
-
-    _permute_mask_head(filled)
 
     return _make_checkpoint(model_id, filled, metadata)
 

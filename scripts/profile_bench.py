@@ -1,0 +1,140 @@
+#!/usr/bin/env python3
+"""Per-op profile of the bench training step on the attached accelerator.
+
+Captures a jax.profiler trace of the same step bench.py measures, parses
+the .xplane.pb directly (tensorboard's converter is broken against the
+installed TF), and prints the top XLA ops by self time plus a category
+rollup. Usage:
+
+    python scripts/profile_bench.py [N]   # N = ops to list (default 30)
+"""
+
+import glob
+import os
+import sys
+import time
+from collections import defaultdict
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+
+def capture(trace_dir):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import raft_meets_dicl_tpu.models as models
+    from raft_meets_dicl_tpu import parallel
+
+    batch = int(os.environ.get("BENCH_BATCH", "6"))
+    height = int(os.environ.get("BENCH_HEIGHT", "400"))
+    width = int(os.environ.get("BENCH_WIDTH", "720"))
+    iters = int(os.environ.get("BENCH_ITERS", "12"))
+    model_ty = os.environ.get("BENCH_MODEL", "raft/baseline")
+    model_params = {"mixed-precision": True} if model_ty == "raft/baseline" \
+        else {}
+    model_args = {"iterations": iters}
+    if model_ty.startswith("raft+dicl/ctf"):
+        levels = int(model_ty[-1])
+        model_args = {"iterations": (iters,) * levels}
+
+    spec = models.load({
+        "name": "bench", "id": "bench",
+        "model": {"type": model_ty, "parameters": model_params},
+        "loss": {"type": "raft/sequence" if model_ty == "raft/baseline"
+                 else "raft+dicl/mlseq"},
+        "input": None,
+    })
+
+    rng = np.random.RandomState(0)
+    img1 = jnp.asarray(rng.rand(batch, height, width, 3), jnp.float32)
+    img2 = jnp.asarray(rng.rand(batch, height, width, 3), jnp.float32)
+    flow = jnp.asarray(rng.randn(batch, height, width, 2), jnp.float32)
+    valid = jnp.ones((batch, height, width), bool)
+
+    init_args = dict(model_args)
+    init_args["iterations"] = (
+        (1,) * len(model_args["iterations"])
+        if isinstance(model_args["iterations"], tuple) else 1)
+    variables = spec.model.init(jax.random.PRNGKey(0), img1[:1], img2[:1],
+                                **init_args)
+
+    tx = optax.chain(optax.clip_by_global_norm(1.0), optax.adamw(4e-4))
+    state = parallel.TrainState.create(variables, tx)
+    step = parallel.make_train_step(spec.model, spec.loss, tx,
+                                    model_args=model_args)
+
+    state, aux = step(state, img1, img2, flow, valid)
+    float(aux["loss"])  # sync (block_until_ready unreliable on the tunnel)
+
+    jax.profiler.start_trace(trace_dir)
+    t0 = time.perf_counter()
+    for _ in range(3):
+        state, aux = step(state, img1, img2, flow, valid)
+    float(aux["loss"])
+    dt = (time.perf_counter() - t0) / 3
+    jax.profiler.stop_trace()
+    print(f"step time: {dt * 1e3:.1f} ms")
+    return dt
+
+
+def parse(trace_dir, top_n=30):
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    files = glob.glob(f"{trace_dir}/**/*.xplane.pb", recursive=True)
+    assert files, f"no xplane under {trace_dir}"
+    newest = max(files, key=os.path.getmtime)
+    xspace = xplane_pb2.XSpace()
+    xspace.ParseFromString(open(newest, "rb").read())
+
+    ops = defaultdict(float)
+    for plane in xspace.planes:
+        if "TPU" not in plane.name and "/device:" not in plane.name:
+            continue
+        for line in plane.lines:
+            if line.name != "XLA Ops":
+                continue
+            evmeta = plane.event_metadata
+            for event in line.events:
+                name = evmeta[event.metadata_id].name
+                # container events double-count their children
+                if name.startswith(("%while", "jit_", "%tuple")):
+                    continue
+                ops[name] += event.duration_ps / 1e9  # ms
+
+    total = sum(ops.values())
+    print(f"\ndevice op time: {total:.1f} ms over {len(ops)} ops")
+
+    cats = defaultdict(float)
+    for name, ms in ops.items():
+        if "fusion" in name:
+            cats["fusion"] += ms
+        elif "convolution" in name or "conv" in name:
+            cats["convolution"] += ms
+        elif "dot" in name or "einsum" in name:
+            cats["dot"] += ms
+        elif "copy" in name or "transpose" in name or "bitcast" in name:
+            cats["copy/transpose"] += ms
+        elif "reduce" in name:
+            cats["reduce"] += ms
+        elif "all-reduce" in name or "all-gather" in name:
+            cats["collective"] += ms
+        else:
+            cats["other"] += ms
+    print("\ncategory rollup:")
+    for cat, ms in sorted(cats.items(), key=lambda kv: -kv[1]):
+        print(f"  {cat:16s} {ms:8.1f} ms  {100 * ms / total:5.1f}%")
+
+    print(f"\ntop {top_n} ops by total time (3 steps):")
+    for name, ms in sorted(ops.items(), key=lambda kv: -kv[1])[:top_n]:
+        print(f"  {ms:8.2f} ms  {name[:110]}")
+
+
+if __name__ == "__main__":
+    top_n = int(sys.argv[1]) if len(sys.argv) > 1 else 30
+    trace_dir = os.environ.get("TRACE_DIR", "/tmp/bench_trace")
+    os.makedirs(trace_dir, exist_ok=True)
+    capture(trace_dir)
+    parse(trace_dir, top_n)

@@ -28,10 +28,8 @@ def _fabricate_torch_state(variables):
     rules = chkpt_convert._raft_rules()
     state = {}
 
-    # inverse of the converter's mask-head channel permutation: flax orders
-    # the 576 channels (subpixel, neighbor), torch (neighbor, subpixel)
-    to_torch_order = np.asarray(
-        [s * 9 + k for k in range(9) for s in range(64)])
+    # no mask-head channel permutation: the flax Up8 head uses torch
+    # RAFT's neighbor-major channel layout natively
 
     for name, leaf in tree_named_leaves(variables):
         col, *path = name.split(".")
@@ -40,9 +38,6 @@ def _fabricate_torch_state(variables):
         torch_mod = rules[module_path]
 
         value = np.asarray(leaf)
-        if module_path == "Up8Network_0.Conv_1":
-            value = (value[..., to_torch_order] if leaf_name == "kernel"
-                     else value[to_torch_order])
         if col == "params":
             if leaf_name == "kernel":
                 key = f"{torch_mod}.weight"
@@ -76,7 +71,6 @@ def test_raft_conversion_roundtrip(tmp_path):
     filled, unused = chkpt_convert._fill_variables(
         variables, state, chkpt_convert._raft_rules())
     assert not unused, f"unmapped torch keys: {sorted(unused)[:5]}"
-    chkpt_convert._permute_mask_head(filled)
 
     # lossless: every leaf returns bit-identical
     orig = dict(tree_named_leaves(variables))
@@ -121,35 +115,30 @@ def test_raft_conversion_end_to_end(tmp_path):
     assert bool(jnp.all(jnp.isfinite(flows[-1])))
 
 
-def test_mask_head_permutation_matches_golden_op():
-    """The (subpixel, neighbor) mask layout + converter permutation must
-    reproduce the torch-ordered convex upsampling exactly — checked against
-    the torch-parity-tested op (ops.convex_upsample_8x), which consumes
-    (neighbor, subpixel)-ordered logits."""
-    import jax.nn
-
-    from raft_meets_dicl_tpu.models.common.util import unfold3x3
-    from raft_meets_dicl_tpu.ops.upsample import convex_upsample_8x
+def test_convex_combine_pallas_matches_reference():
+    """The fused Pallas mask-combine kernel (fwd + custom VJP, run in
+    interpreter mode off-TPU) must match the XLA reference semantics the
+    torch-parity tests validate."""
+    from raft_meets_dicl_tpu.ops import pallas as pk
 
     rs = np.random.RandomState(11)
-    b, h, w = 2, 6, 8
-    logits_t = jnp.asarray(rs.randn(b, h, w, 9 * 64), jnp.float32)  # (k, s)
-    flow = jnp.asarray(rs.randn(b, h, w, 2), jnp.float32)
+    m = 700  # not a multiple of the row tile: exercises padding
+    logits = jnp.asarray(rs.randn(m, 576), jnp.float32)
+    win = jnp.asarray(rs.randn(m, 9 * 2), jnp.float32)
 
-    expected = convex_upsample_8x(flow, logits_t, temperature=4.0)
-
-    # converter-permuted logits, evaluated with the Up8Network math
-    perm = np.argsort([s * 9 + k for k in range(9) for s in range(64)])
-    logits_f = logits_t[..., perm]
-
-    mask = logits_f.reshape(b, h, w, 64, 9)
-    mask = jax.nn.softmax(mask / 4.0, axis=-1)
-    win = unfold3x3(8.0 * flow)
-    up = jnp.einsum("bhwsk,bhwkc->bhwsc", mask, win)
-    up = up.reshape(b, h, w, 8, 8, 2).transpose(0, 1, 3, 2, 4, 5)
-    actual = up.reshape(b, h * 8, w * 8, 2)
-
+    expected = pk._combine_reference(logits, win, 0.25)
+    actual = pk._run_fwd_interpret(logits, win, 0.25)
     assert np.allclose(np.asarray(actual), np.asarray(expected), atol=1e-5)
+
+    # backward: compare the pallas bwd kernel against autodiff of the
+    # reference
+    dout = jnp.asarray(rs.randn(m, 128), jnp.float32)
+    _, vjp = jax.vjp(lambda lg, wn: pk._combine_reference(lg, wn, 0.25),
+                     logits, win)
+    dl_ref, dw_ref = vjp(dout)
+    dl, dw = pk._run_bwd_interpret(logits, win, dout, 0.25)
+    assert np.allclose(np.asarray(dl), np.asarray(dl_ref), atol=1e-5)
+    assert np.allclose(np.asarray(dw), np.asarray(dw_ref), atol=1e-5)
 
 
 def test_dicl_conversion_roundtrip():

@@ -155,7 +155,8 @@ def test_softargmax_regression_peak():
 
 def test_unfold3x3_center():
     x = jnp.arange(16, dtype=jnp.float32).reshape(1, 4, 4, 1)
-    w = raft_impl.unfold3x3(x)
+    from raft_meets_dicl_tpu.models.common.util import unfold3x3
+    w = unfold3x3(x)
     assert w.shape == (1, 4, 4, 9, 1)
     # center of each window is the pixel itself
     np.testing.assert_array_equal(np.asarray(w[..., 4, :]), np.asarray(x))
