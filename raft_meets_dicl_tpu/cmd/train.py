@@ -129,24 +129,46 @@ def load_config_parts(args):
 def _train(args):
     timestamp = datetime.datetime.now()
 
-    suffix = ""
-    if args.suffix:
-        suffix = args.suffix if re.match(r"^[./_-].*$", args.suffix) else f"-{args.suffix}"
-
-    path_out = Path(args.output) / (timestamp.strftime("%G.%m.%dT%H.%M.%S") + suffix)
-    path_out.mkdir(parents=True)
-
-    utils.logging.setup(path_out / "main.log")
-    logging.info(f"starting: time is {timestamp}, writing to '{path_out}'")
-    logging.info(f"description: {args.comment if args.comment else '<not available>'}")
-
     cfg_seeds, cfg_env, cfg_model, cfg_strat, cfg_inspc, base_path = \
         load_config_parts(args)
 
     # env flags must land before anything touches jax (XLA parses flags at
-    # backend init; seeds.apply() creates the first PRNG key)
+    # backend init — and the distributed handshake below brings the
+    # backend up); seeds.apply() creates the first PRNG key
     env = Environment.load(cfg_env)
     env.apply()
+
+    # multi-host: join the process group before any other backend use;
+    # only the primary process owns the run directory, logs, and
+    # checkpoints (SURVEY §5.8 — the pod-scale replacement for the
+    # reference's single-host nn.DataParallel, src/cmd/train.py:183-184)
+    primary = True
+    if getattr(args, "distributed", False):
+        parallel.initialize(
+            coordinator=args.dist_coordinator,
+            num_processes=args.dist_num_processes,
+            process_id=args.dist_process_id,
+        )
+        primary = parallel.is_primary()
+
+    suffix = ""
+    if args.suffix:
+        suffix = args.suffix if re.match(r"^[./_-].*$", args.suffix) else f"-{args.suffix}"
+
+    if primary:
+        path_out = Path(args.output) / (timestamp.strftime("%G.%m.%dT%H.%M.%S") + suffix)
+        path_out.mkdir(parents=True)
+        utils.logging.setup(path_out / "main.log")
+    else:
+        # secondary processes compute, they don't publish: artifacts go
+        # to a scratch dir (checkpoint writes themselves are gated to the
+        # primary in CheckpointManager.create), logging stays on console
+        import tempfile
+
+        path_out = Path(tempfile.mkdtemp(prefix="train-secondary-"))
+        utils.logging.setup()
+    logging.info(f"starting: time is {timestamp}, writing to '{path_out}'")
+    logging.info(f"description: {args.comment if args.comment else '<not available>'}")
 
     # seeds (apply() seeds host RNGs and yields the root jax key)
     if args.reproduce or args.seeds:

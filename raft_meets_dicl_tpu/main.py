@@ -66,6 +66,16 @@ def main():
     train.add_argument("--comment", dest="comment", help="comment to add to config file")
     train.add_argument("--limit-steps", type=int, dest="steps",
                        help="limit to a fixed number of steps")
+    train.add_argument("--distributed", action="store_true",
+                       help="join the multi-process runtime "
+                            "(jax.distributed.initialize; on TPU pods "
+                            "coordinator/rank are auto-discovered)")
+    train.add_argument("--dist-coordinator", metavar="HOST:PORT",
+                       help="coordinator address for non-TPU setups")
+    train.add_argument("--dist-num-processes", type=int,
+                       help="total process count for non-TPU setups")
+    train.add_argument("--dist-process-id", type=int,
+                       help="this process's id for non-TPU setups")
     train.add_argument("--profile", metavar="DIR",
                        help="capture a jax.profiler trace of the run into DIR "
                             "(open with TensorBoard's profile plugin); "

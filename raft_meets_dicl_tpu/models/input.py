@@ -315,8 +315,9 @@ class JaxAdapter:
         return len(self.source)
 
     def loader(self, batch_size=1, shuffle=False, num_workers=4, drop_last=False,
-               seed=None, **loader_args):
-        return Loader(self, batch_size, shuffle, num_workers, drop_last, seed)
+               seed=None, shard=None, **loader_args):
+        return Loader(self, batch_size, shuffle, num_workers, drop_last, seed,
+                      shard)
 
 
 def collate(samples, shuffle=False, rng=None):
@@ -359,21 +360,37 @@ class Loader:
     Shuffling uses an own Generator. Without an explicit ``seed`` it is
     derived from the global numpy RNG so run-level seeding
     (utils.seeds) still makes data order reproducible.
+
+    ``shard=(index, count)`` restricts the loader to every count-th
+    sample of the (shared-seed) epoch order — the per-process slice in
+    multi-host training. All shards see the same number of batches
+    (processes must step in lockstep), so ``batch_size`` here is the
+    per-process size.
     """
 
     def __init__(self, source, batch_size=1, shuffle=False, num_workers=4,
-                 drop_last=False, seed=None):
+                 drop_last=False, seed=None, shard=None):
         self.source = source
         self.batch_size = batch_size
         self.shuffle = shuffle
         self.num_workers = num_workers
         self.drop_last = drop_last
+        self.shard = shard
         if seed is None:
             seed = int(np.random.randint(0, 2**31 - 1))
         self.rng = np.random.default_rng(seed)
 
-    def __len__(self):
+    def _shard_len(self):
         n = len(self.source)
+        if self.shard is None:
+            return n
+        index, count = self.shard
+        # every shard gets the same length: floor, so trailing samples
+        # that not all shards have are dropped
+        return n // count
+
+    def __len__(self):
+        n = self._shard_len()
         if self.drop_last:
             return n // self.batch_size
         return -(-n // self.batch_size)
@@ -381,6 +398,10 @@ class Loader:
     def _batches(self):
         order = self.rng.permutation(len(self.source)) if self.shuffle \
             else np.arange(len(self.source))
+
+        if self.shard is not None:
+            index, count = self.shard
+            order = order[index::count][: self._shard_len()]
 
         for start in range(0, len(order), self.batch_size):
             chunk = order[start : start + self.batch_size]

@@ -15,10 +15,12 @@ Axes:
   at high resolution (the framework's long-context axis)
 """
 
+from .distributed import initialize, is_primary, process_count, process_index
 from .mesh import data_mesh, replicate, shard_batch
 from .train import TrainState, make_eval_step, make_train_step
 
 __all__ = [
     "data_mesh", "replicate", "shard_batch",
     "TrainState", "make_eval_step", "make_train_step",
+    "initialize", "is_primary", "process_count", "process_index",
 ]

@@ -1,0 +1,68 @@
+"""Multi-process (multi-host) execution setup.
+
+The reference scales with single-host ``nn.DataParallel``
+(src/cmd/train.py:183-184); the TPU-native equivalent at pod scale is
+multi-process JAX: one process per host, ``jax.distributed.initialize``
+to form the global runtime, a global mesh over all chips, and
+per-process input feeding (each host loads only its slice of the batch,
+assembled into one global array via
+``jax.make_array_from_process_local_data`` — see mesh.shard_batch).
+
+Launch contract (scripts/cluster/train.sh): on TPU pods the coordinator
+address/process count/process id are discovered by libtpu, so
+``initialize()`` with no arguments is enough; other setups pass them
+explicitly or via env (JAX_COORDINATOR_ADDRESS, JAX_NUM_PROCESSES,
+JAX_PROCESS_ID).
+"""
+
+import logging
+
+
+def initialize(coordinator=None, num_processes=None, process_id=None,
+               local_device_ids=None):
+    """Join (or form) the multi-process JAX runtime.
+
+    Must run before anything touches a jax backend. No-op when the
+    runtime is already initialized.
+    """
+    import jax
+
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+            local_device_ids=local_device_ids,
+        )
+    except RuntimeError as e:
+        # jax raises 'should only be called once' on re-initialization
+        if "once" in str(e) or "already initialized" in str(e):
+            logging.warning(f"jax.distributed already initialized: {e}")
+        else:
+            raise
+
+    import jax as _jax  # backend comes up on first query
+
+    logging.info(
+        f"distributed: process {_jax.process_index()}/{_jax.process_count()}, "
+        f"{_jax.local_device_count()} local of {_jax.device_count()} devices"
+    )
+
+
+def process_count():
+    import jax
+
+    return jax.process_count()
+
+
+def process_index():
+    import jax
+
+    return jax.process_index()
+
+
+def is_primary():
+    """True on the process that owns logging / checkpoint / report writes."""
+    import jax
+
+    return jax.process_index() == 0

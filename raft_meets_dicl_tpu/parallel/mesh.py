@@ -20,10 +20,20 @@ def data_mesh(n_devices=None, axis_name="data", devices=None):
 def shard_batch(batch, mesh, axis_name="data"):
     """Place a host batch on the mesh, sharded along the leading axis.
 
-    The global batch size must divide the mesh axis size. Works on any
+    Single-process: ``batch`` is the global batch, device_put with a
+    sharded layout. Multi-process (multi-host pods): ``batch`` is this
+    process's LOCAL slice — the global array is assembled from every
+    process's contribution (``jax.make_array_from_process_local_data``),
+    so the global batch size is ``local · process_count``. Works on any
     pytree of arrays with a common leading batch dimension.
     """
     spec = NamedSharding(mesh, P(axis_name))
+    if jax.process_count() > 1:
+        return jax.tree.map(
+            lambda x: jax.make_array_from_process_local_data(
+                spec, np.asarray(x)),
+            batch,
+        )
     return jax.tree.map(lambda x: jax.device_put(x, spec), batch)
 
 

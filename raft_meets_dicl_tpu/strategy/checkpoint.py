@@ -284,7 +284,16 @@ class CheckpointManager:
                 entry.path.unlink(missing_ok=True)
 
     def create(self, log, ctx, stage, epoch, step, metrics):
-        """Save a checkpoint from the live training context and trim."""
+        """Save a checkpoint from the live training context and trim.
+
+        Multi-host: only the primary process publishes (secondary
+        processes compute the same replicated state — serializing it N
+        times would just fill the workers' disks)."""
+        import jax
+
+        if jax.process_index() != 0:
+            return
+
         epoch_int = epoch if epoch is not None else stage.data.epochs
         entry = CheckpointEntry(self.model_id, stage.index, epoch_int, step,
                                 metrics, None)

@@ -108,7 +108,15 @@ class TrainingContext:
         self.log.info("initializing model parameters")
         img1, img2, *_ = self.input.apply(stage.data.source).jax()[0]
 
-        rng = jax.random.PRNGKey(int(np.random.randint(0, 2**31 - 1)))
+        seed = int(np.random.randint(0, 2**31 - 1))
+        if jax.process_count() > 1:
+            # every process must initialize identical parameters (replicate
+            # trusts but never verifies same-value-per-process): broadcast
+            # process 0's seed
+            from jax.experimental import multihost_utils
+
+            seed = int(multihost_utils.broadcast_one_to_all(np.int32(seed)))
+        rng = jax.random.PRNGKey(seed)
         init_args = dict(self.model.arguments)
         # keep tracing cheap: recurrent iteration counts don't affect params
         if "iterations" in init_args:
@@ -197,9 +205,32 @@ class TrainingContext:
         log.info(f"loading dataset: {stage.data.source.description()}")
         loader_args = self.loader_args | stage.loader_args
 
+        # multi-host: the configured batch size is GLOBAL; each process
+        # loads its slice (same-seed epoch order, strided shard) and the
+        # global batch is assembled in parallel.shard_batch
+        n_proc = jax.process_count()
+        batch_size = stage.data.batch_size
+        if n_proc > 1:
+            if batch_size % n_proc:
+                raise ValueError(
+                    f"global batch size {batch_size} does not divide over "
+                    f"{n_proc} processes"
+                )
+            batch_size //= n_proc
+            loader_args.setdefault("shard", (jax.process_index(), n_proc))
+            if "seed" not in loader_args:
+                # all processes must draw the same epoch order; broadcast a
+                # seed from process 0's (run-seeded) RNG so --reproduce
+                # still governs data order
+                from jax.experimental import multihost_utils
+
+                seed = int(np.random.randint(0, 2**31 - 1))
+                loader_args["seed"] = int(
+                    multihost_utils.broadcast_one_to_all(np.int32(seed)))
+
         input = self.input.apply(stage.data.source).jax()
         self.data = input.loader(
-            batch_size=stage.data.batch_size,
+            batch_size=batch_size,
             shuffle=stage.data.shuffle,
             drop_last=stage.data.drop_last,
             **loader_args,

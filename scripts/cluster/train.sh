@@ -4,9 +4,13 @@
 # TPU-native equivalent of the reference's SLURM submission script
 # (reference scripts/cluster/train.sh:1-31): instead of sbatch + CUDA env
 # modules, this drives `gcloud compute tpus tpu-vm ssh --worker=all` so the
-# same SPMD program runs on every host of the slice. jax initializes the
-# distributed runtime from the TPU environment automatically; the data
-# mesh then spans all chips (ICI within the slice).
+# same SPMD program runs on every host of the slice. `--distributed` joins
+# the multi-process runtime (jax.distributed.initialize; coordinator and
+# rank are auto-discovered on TPU pods), the data mesh then spans all
+# chips (ICI within the slice), each host loads its per-process batch
+# shard, and only worker 0 writes logs/checkpoints
+# (raft_meets_dicl_tpu/parallel/distributed.py; exercised end-to-end on a
+# 2-process virtual cluster by tests/test_distributed.py).
 #
 # Usage:
 #   TPU_NAME=my-pod ZONE=us-central2-b ./scripts/cluster/train.sh \
@@ -19,4 +23,4 @@ ZONE="${ZONE:?set ZONE to the TPU zone}"
 REPO_DIR="${REPO_DIR:-\$HOME/raft_meets_dicl_tpu}"
 
 gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" --worker=all \
-    --command "cd $REPO_DIR && python3 main.py train $*"
+    --command "cd $REPO_DIR && python3 main.py train --distributed $*"
