@@ -97,7 +97,14 @@ class Subset(Collection):
         super().__init__()
         self.size = size
         self.source = source
-        self.map = np.random.randint(0, len(source), size=size)
+        # an empty source yields an empty subset (a not-yet-populated
+        # dataset root must still spec-load)
+        n = len(source)
+        self.map = (np.random.randint(0, n, size=size) if n
+                    else np.empty(0, np.int64))
+
+    def __len__(self):
+        return len(self.map)
 
     def get_config(self):
         return {
@@ -108,9 +115,6 @@ class Subset(Collection):
 
     def __getitem__(self, index):
         return self.source[self.map[index]]
-
-    def __len__(self):
-        return self.size
 
     def description(self):
         return f"{self.source.description()}, subset {self.size}"

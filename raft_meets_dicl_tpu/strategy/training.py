@@ -238,6 +238,14 @@ class TrainingContext:
         log.info(
             f"dataset loaded: have {len(self.data)} batches over {len(input)} samples"
         )
+        if len(input) == 0:
+            # combinators tolerate empty sources so bare specs can load
+            # without mounted data; actually training on nothing is a
+            # config error and must fail fast
+            raise ValueError(
+                "dataset resolved to zero samples: "
+                f"{stage.data.source.description()}"
+            )
 
         # optimizer (fresh per stage, like the reference)
         log.info("setting up optimizer")
