@@ -3,7 +3,8 @@
 Mirrors the reference's FlyingThings3D training configuration (batch 6,
 720x400 crops, 12 GRU iterations, AdamW + grad clip —
 cfg/strategy/baseline/raft/s1-things.yaml) as a synthetic-data training-step
-benchmark on one chip. Prints ONE JSON line.
+benchmark on one chip. Prints ONE JSON line; the same line carries the
+thesis flagship's (raft+dicl/ctf-l3) throughput as an extra key.
 
 ``vs_baseline`` compares against the north-star target of 400 image-pairs/s
 on a v4-32 (32 chips) => 12.5 pairs/s/chip (BASELINE.json; the reference
@@ -21,33 +22,17 @@ import numpy as np
 BASELINE_PAIRS_PER_SEC_PER_CHIP = 400.0 / 32.0
 
 
-def main():
+def _measure(model_cfg, loss_cfg, batch, height, width, model_args, steps):
+    """One synthetic training-step throughput measurement; all device
+    state is local, so buffers free when it returns."""
     import optax
 
     import raft_meets_dicl_tpu.models as models
     from raft_meets_dicl_tpu import parallel
 
-    batch = int(os.environ.get("BENCH_BATCH", "6"))
-    height = int(os.environ.get("BENCH_HEIGHT", "400"))
-    width = int(os.environ.get("BENCH_WIDTH", "720"))
-    iters = int(os.environ.get("BENCH_ITERS", "12"))
-    steps = int(os.environ.get("BENCH_STEPS", "10"))
-
-    if jax.default_backend() == "cpu":
-        # CPU fallback (no TPU attached): tiny shapes, still one JSON line
-        batch, height, width, iters, steps = 2, 64, 96, 4, 3
-
     spec = models.load({
         "name": "bench", "id": "bench",
-        # mixed-precision bf16 is the TPU-native policy (the reference's
-        # autocast equivalent). Profiling history at this config:
-        # - scalar-gather corr lookup: ~17 s/step; einsum lookup: 0.67 s
-        # - convex Up8 hoisted out of the remat'd scan (batched over
-        #   iterations, compact (s,k) mask layout): 0.45 s
-        # - remat policy saving the per-iteration corr lookups: 0.43 s
-        "model": {"type": "raft/baseline", "parameters": {"mixed-precision": True}},
-        "loss": {"type": "raft/sequence"},
-        "input": None,
+        "model": model_cfg, "loss": loss_cfg, "input": None,
     })
     model, loss = spec.model, spec.loss
 
@@ -57,14 +42,16 @@ def main():
     flow = jnp.asarray(rng.randn(batch, height, width, 2), jnp.float32)
     valid = jnp.ones((batch, height, width), bool)
 
-    variables = model.init(jax.random.PRNGKey(0), img1[:1], img2[:1], iterations=2)
+    init_args = dict(model_args)
+    init_args["iterations"] = (
+        (1,) * len(model_args["iterations"])
+        if isinstance(model_args["iterations"], tuple) else 1)
+    variables = model.init(jax.random.PRNGKey(0), img1[:1], img2[:1],
+                           **init_args)
 
     tx = optax.chain(optax.clip_by_global_norm(1.0), optax.adamw(4e-4))
     state = parallel.TrainState.create(variables, tx)
-
-    step = parallel.make_train_step(
-        model, loss, tx, model_args={"iterations": iters}
-    )
+    step = parallel.make_train_step(model, loss, tx, model_args=model_args)
 
     # warmup / compile; sync by fetching the scalar — on the tunneled axon
     # backend block_until_ready does not reliably wait, value transfer does
@@ -77,14 +64,60 @@ def main():
     float(aux["loss"])
     dt = time.perf_counter() - t0
 
-    pairs_per_sec = batch * steps / dt
+    stats = jax.local_devices()[0].memory_stats() or {}
+    return batch * steps / dt, stats.get("peak_bytes_in_use", 0)
 
-    print(json.dumps({
+
+def main():
+    batch = int(os.environ.get("BENCH_BATCH", "6"))
+    height = int(os.environ.get("BENCH_HEIGHT", "400"))
+    width = int(os.environ.get("BENCH_WIDTH", "720"))
+    iters = int(os.environ.get("BENCH_ITERS", "12"))
+    steps = int(os.environ.get("BENCH_STEPS", "10"))
+
+    if jax.default_backend() == "cpu":
+        # CPU fallback (no TPU attached): tiny shapes, still one JSON line
+        batch, height, width, iters, steps = 2, 64, 96, 4, 3
+
+    # mixed-precision bf16 is the TPU-native policy (the reference's
+    # autocast equivalent). Profiling history at this config:
+    # - scalar-gather corr lookup: ~17 s/step; einsum lookup: 0.67 s
+    # - convex Up8 hoisted out of the remat'd scan, compact mask layout,
+    #   remat policy saving the corr lookups: 0.43 s
+    # - fused Pallas softmax+combine Up8 kernel (ops/pallas.py): 0.39 s
+    pairs_per_sec, _ = _measure(
+        {"type": "raft/baseline", "parameters": {"mixed-precision": True}},
+        {"type": "raft/sequence"},
+        batch, height, width, {"iterations": iters}, steps,
+    )
+
+    result = {
         "metric": "train-throughput-raft-things",
         "value": round(pairs_per_sec, 3),
         "unit": "image-pairs/sec/chip",
         "vs_baseline": round(pairs_per_sec / BASELINE_PAIRS_PER_SEC_PER_CHIP, 3),
-    }))
+    }
+
+    if os.environ.get("BENCH_FLAGSHIP", "1") != "0":
+        # the thesis flagship at a Things-like config (pyramid needs
+        # multiples of 64; f32 — no mixed-precision path in the ctf family
+        # yet); a flagship failure must not lose the main measurement
+        try:
+            if jax.default_backend() == "cpu":
+                fb, fh, fw, fi, fs = 1, 64, 128, (2, 1, 1), 2
+            else:
+                fb, fh, fw, fi, fs = 6, 384, 704, (4, 3, 3), 5
+            ctf_pairs, _ = _measure(
+                {"type": "raft+dicl/ctf-l3", "parameters": {}},
+                {"type": "raft+dicl/mlseq",
+                 "arguments": {"alpha": [0.38, 0.6, 1.0]}},
+                fb, fh, fw, {"iterations": fi}, fs,
+            )
+            result["ctf_l3_pairs_per_sec"] = round(ctf_pairs, 3)
+        except Exception as e:  # noqa: BLE001 - report, don't lose the line
+            result["ctf_l3_error"] = f"{type(e).__name__}: {str(e)[:120]}"
+
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

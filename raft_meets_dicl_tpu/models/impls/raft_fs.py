@@ -99,8 +99,12 @@ class RaftFsModule(nn.Module):
         )
 
         fmap1, fmap2 = fnet((img1, img2), train, frozen_bn)
-        fmap1 = fmap1.astype(jnp.float32)
-        fmap2 = fmap2.astype(jnp.float32)
+        if dt is None:
+            fmap1 = fmap1.astype(jnp.float32)
+            fmap2 = fmap2.astype(jnp.float32)
+        # under the bf16 policy the feature maps stay bf16: halves the
+        # windowed-correlation kernel's VMEM blocks (the accumulation is
+        # f32 inside the kernel)
 
         # avg-pooled second-frame feature pyramid (raft_fs.py:26-31)
         pyramid = [fmap2]

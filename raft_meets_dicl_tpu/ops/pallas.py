@@ -116,6 +116,8 @@ def _run_fwd(logits2d, win2d, inv_temp, interpret=False):
         ],
         out_specs=pl.BlockSpec((_TILE, _C * _S), lambda i: (i, 0),
                                memory_space=pltpu.VMEM),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=32 * 1024 * 1024),
         interpret=interpret,
     )(logits2d, win2d)
     return out[:m]
@@ -148,6 +150,10 @@ def _run_bwd(logits2d, win2d, dout2d, inv_temp, interpret=False):
             pl.BlockSpec((_TILE, _K * _C), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
         ),
+        # f32 callers (the ctf family runs un-mixed) land just past the
+        # 16M default with double buffering
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=32 * 1024 * 1024),
         interpret=interpret,
     )(logits2d, win2d, dout2d)
     return dlogits[:m], dwin[:m]
@@ -411,6 +417,8 @@ def _wcp_fwd_tpu(f1, f2_levels, coords, radius, interpret=False):
         out_specs=pl.BlockSpec((1, 1, n_j, n_lvl * k, k),
                                lambda bi, ii: (bi, ii, 0, 0, 0),
                                memory_space=pltpu.VMEM),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )(coords, f1r, *f2p)
     # (level, dx, dy) channel flatten — (L*k, k) row-major is exactly that
@@ -447,6 +455,8 @@ def _wcp_bwd_tpu(f1, f2_levels, coords, dout, radius, interpret=False):
             for f2 in f2p
         ],
         out_specs=row_spec,
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )(coords, doutr, *f2p).reshape(b, n_i, n_j, c)
 
@@ -469,7 +479,7 @@ def _wcp_bwd_tpu(f1, f2_levels, coords, dout, radius, interpret=False):
                                    lambda bi, ii: (bi, 0, 0, 0),
                                    memory_space=pltpu.VMEM),
             compiler_params=pltpu.CompilerParams(
-                vmem_limit_bytes=64 * 1024 * 1024),
+                vmem_limit_bytes=100 * 1024 * 1024),
             interpret=interpret,
         )(coords, f1r, dout_l)
 
@@ -492,9 +502,27 @@ def _wcp_reference(f1, f2_levels, coords, radius):
     return jnp.concatenate(out, axis=-1)
 
 
+def _wcp_fits_vmem(f1, f2_levels, radius):
+    """Static shape check: the kernel holds one (b, i)-row of state plus
+    every padded f2 map in VMEM; beyond ~64M even the raised compiler
+    budget cannot place it, so oversized shapes take the XLA path."""
+    lo, hi_y, hi_x = _wcp_pads(radius)
+    k = 2 * radius + 1
+    n_lvl = len(f2_levels)
+    n_j, c = f1.shape[2], f1.shape[3]
+    itemsize = 2 if f1.dtype == jnp.bfloat16 else 4
+    total = n_j * (n_lvl * k + 8) * 128 * 4        # out block (padded)
+    total += n_j * 8 * c * itemsize                # f1 row block
+    for f2 in f2_levels:
+        total += (f2.shape[1] + lo + hi_y) * (f2.shape[2] + lo + hi_x) \
+            * c * itemsize
+    return total <= 64 * 1024 * 1024
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def _wcp(f1, f2_levels, coords, radius):
-    if jax.default_backend() == "tpu":
+    if jax.default_backend() == "tpu" and _wcp_fits_vmem(f1, f2_levels,
+                                                        radius):
         return _wcp_fwd_tpu(f1, f2_levels, coords, radius)
     return _wcp_reference(f1, f2_levels, coords, radius)
 
@@ -505,7 +533,8 @@ def _wcp_vjp_fwd(f1, f2_levels, coords, radius):
 
 def _wcp_vjp_bwd(radius, res, dout):
     f1, f2_levels, coords = res
-    if jax.default_backend() == "tpu":
+    if jax.default_backend() == "tpu" and _wcp_fits_vmem(f1, f2_levels,
+                                                        radius):
         df1, df2 = _wcp_bwd_tpu(f1, f2_levels, coords, dout, radius)
     else:
         def f(f1_, f2_):

@@ -1,0 +1,68 @@
+#!/usr/bin/env python3
+"""High-resolution (HD1K 1080p) training-step stress benchmark.
+
+BASELINE configs[4] — the KITTI/HD1K fine-tune at native resolution is
+the high-res correlation stress case (SURVEY §5.7): at 2560x1072 the
+1/8-scale all-pairs volume is (320*134)^2 elements ~= 3.4 GB in bf16
+per sample before gradients, so ``raft/baseline`` cannot train there.
+``raft/fs`` computes the correlation windows on the fly instead:
+O(B*H*W*C) memory at any resolution. This benchmark runs one-sample
+training steps of raft/fs at the cfg/strategy/highres recipe's crop,
+reports throughput and peak HBM, and (optionally) demonstrates the
+baseline's behavior at the same config.
+
+    python scripts/bench_1080p.py [--try-baseline]
+"""
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+import bench  # noqa: E402  (the shared train-step measurement harness)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--try-baseline", action="store_true",
+                    help="also attempt raft/baseline at 1080p")
+    ap.add_argument("--height", type=int, default=1072)
+    ap.add_argument("--width", type=int, default=2560)
+    ap.add_argument("--iters", type=int, default=12)
+    ap.add_argument("--steps", type=int, default=2)
+    args = ap.parse_args()
+
+    result = {
+        "metric": "train-throughput-raft-fs-1080p",
+        "config": f"{args.width}x{args.height} batch 1, "
+                  f"{args.iters} iterations, bf16",
+        "unit": "image-pairs/sec/chip",
+    }
+
+    pairs, peak = bench._measure(
+        {"type": "raft/fs", "parameters": {"mixed-precision": True}},
+        {"type": "raft/sequence"},
+        1, args.height, args.width, {"iterations": args.iters}, args.steps)
+    result["value"] = round(pairs, 4)
+    result["peak_hbm_gib"] = round(peak / 2**30, 2)
+
+    if args.try_baseline:
+        try:
+            pairs_b, peak_b = bench._measure(
+                {"type": "raft/baseline",
+                 "parameters": {"mixed-precision": True}},
+                {"type": "raft/sequence"},
+                1, args.height, args.width, {"iterations": args.iters},
+                args.steps)
+            result["baseline_value"] = round(pairs_b, 4)
+            result["baseline_peak_hbm_gib"] = round(peak_b / 2**30, 2)
+        except Exception as e:  # noqa: BLE001 - the failure IS the datum
+            result["baseline_error"] = f"{type(e).__name__}: {str(e)[:120]}"
+
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,124 @@
+#!/usr/bin/env python3
+"""Flow-image matrix dump: models × training stages × cost-mask variants.
+
+Capability parity with reference scripts/eval/multi-flow.py: for each
+configured (model, checkpoint) pair and each ``mask_costs`` variant
+(zeroing correlation-cost levels by pyramid id), run the evaluation
+command with flow-image output — the qualitative matrix used to study
+what each correlation level contributes.
+
+Edit the ``models`` / ``mask`` / ``data`` tables below for your runs,
+then:  ./scripts/eval/multi-flow.py
+"""
+
+import json
+import sys
+import tempfile
+import types
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict
+
+sys.path.insert(0, str(Path(__file__).parent.parent.parent))
+
+import raft_meets_dicl_tpu as fw  # noqa: E402
+import raft_meets_dicl_tpu.cmd.eval as cmd_eval  # noqa: E402
+
+DIR_OUT = Path("out/flow")
+
+mask = {
+    "base": (),
+    "mask-3": (3,),
+    "mask-34": (3, 4),
+    "mask-4": (4,),
+}
+
+data = "cfg/data/mpi-sintel-clean.visual.yaml"
+
+
+@dataclass
+class Stage:
+    model: str
+    checkpoint: str
+
+
+@dataclass
+class Model:
+    stages: Dict[str, Stage]
+
+
+# fill in run artifacts: model = a model yaml or a run's config.json,
+# checkpoint = the matching .ckpt
+models = {
+    "raft-baseline": Model(
+        stages={
+            "chairs": Stage(
+                model="cfg/model/raft-baseline.yaml",
+                checkpoint="runs/<run>/checkpoints/<chkpt>.ckpt",
+            ),
+        }
+    ),
+}
+
+
+def do_evaluate(model, checkpoint, data_path, flow_out):
+    args = types.SimpleNamespace(
+        device=None,
+        device_ids=None,
+        batch_size=1,
+        model=model,
+        checkpoint=checkpoint,
+        data=data_path,
+        output=None,
+        metrics=None,
+        flow=str(flow_out),
+        flow_only=True,
+        flow_format="visual:flow",
+        flow_mrm=60,
+        flow_gamma=None,
+        flow_transform=None,
+        epe_max=None,
+        epe_cmap=None,
+    )
+    cmd_eval.evaluate(args)
+
+
+def path_validate(path):
+    if not Path(path).is_file():
+        raise RuntimeError(f"path does not exist: '{path}'")
+
+
+def update_model(model_file, model_src, mask_costs):
+    cfg = fw.utils.config.load(model_src)
+    if "model" in cfg and "strategy" in cfg:  # frozen full config
+        cfg = cfg["model"]
+    model = fw.models.load(cfg)
+
+    model.model.arguments["mask_costs"] = list(mask_costs)
+    model_cfg = json.dumps(model.get_config())
+
+    model_file.seek(0)
+    model_file.truncate(0)
+    model_file.write(model_cfg.encode("utf-8"))
+    model_file.flush()
+
+
+def main():
+    for model in models.values():
+        for stage in model.stages.values():
+            path_validate(stage.model)
+            path_validate(stage.checkpoint)
+
+    with tempfile.NamedTemporaryFile(suffix=".json") as model_file:
+        for model_name, model in models.items():
+            for stage_name, stage in model.stages.items():
+                for mask_name, ms in mask.items():
+                    output = DIR_OUT / model_name / stage_name / mask_name
+
+                    update_model(model_file, stage.model, ms)
+                    do_evaluate(model_file.name, stage.checkpoint, data,
+                                output)
+
+
+if __name__ == "__main__":
+    main()
