@@ -64,6 +64,8 @@ def _measure(model_cfg, loss_cfg, batch, height, width, model_args, steps):
     float(aux["loss"])
     dt = time.perf_counter() - t0
 
+    # peak_bytes_in_use is a process-lifetime high-water mark: meaningful
+    # for the first measurement in a process, an upper bound afterwards
     stats = jax.local_devices()[0].memory_stats() or {}
     return batch * steps / dt, stats.get("peak_bytes_in_use", 0)
 
@@ -100,15 +102,16 @@ def main():
 
     if os.environ.get("BENCH_FLAGSHIP", "1") != "0":
         # the thesis flagship at a Things-like config (pyramid needs
-        # multiples of 64; f32 — no mixed-precision path in the ctf family
-        # yet); a flagship failure must not lose the main measurement
+        # multiples of 64) under the bf16 policy; a flagship failure must
+        # not lose the main measurement
         try:
             if jax.default_backend() == "cpu":
                 fb, fh, fw, fi, fs = 1, 64, 128, (2, 1, 1), 2
             else:
                 fb, fh, fw, fi, fs = 6, 384, 704, (4, 3, 3), 5
             ctf_pairs, _ = _measure(
-                {"type": "raft+dicl/ctf-l3", "parameters": {}},
+                {"type": "raft+dicl/ctf-l3",
+                 "parameters": {"mixed-precision": True}},
                 {"type": "raft+dicl/mlseq",
                  "arguments": {"alpha": [0.38, 0.6, 1.0]}},
                 fb, fh, fw, {"iterations": fi}, fs,

@@ -14,6 +14,8 @@ TPU-native layout decisions:
   the TPU MXU (channels-last matmul over du*dv).
 """
 
+from typing import Any
+
 import flax.linen as nn
 import jax.numpy as jnp
 
@@ -30,6 +32,7 @@ class ConvBlock(nn.Module):
     dilation: int = 1
     norm_type: str = "batch"
     num_groups: int = 8
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x, train=False, frozen_bn=False):
@@ -42,8 +45,10 @@ class ConvBlock(nn.Module):
             kernel_dilation=self.dilation,
             padding=self.dilation * (self.kernel_size // 2),
             use_bias=False,
+            dtype=self.dtype,
         )(x)
-        x = Norm2d(self.norm_type, self.num_groups)(x, train and not frozen_bn)
+        x = Norm2d(self.norm_type, self.num_groups, dtype=self.dtype)(
+            x, train and not frozen_bn)
         return nn.relu(x)
 
 
@@ -59,13 +64,16 @@ class ConvBlockTransposed(nn.Module):
     c_out: int
     norm_type: str = "batch"
     num_groups: int = 8
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x, train=False, frozen_bn=False):
         x = nn.ConvTranspose(
             self.c_out, (4, 4), strides=(2, 2), padding="SAME", use_bias=False,
+            dtype=self.dtype,
         )(x)
-        x = Norm2d(self.norm_type, self.num_groups)(x, train and not frozen_bn)
+        x = Norm2d(self.norm_type, self.num_groups, dtype=self.dtype)(
+            x, train and not frozen_bn)
         return nn.relu(x)
 
 
@@ -121,10 +129,12 @@ class MatchingNet(nn.Module):
 
     norm_type: str = "batch"
     scale: float = 1
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, mvol, train=False, frozen_bn=False):
         b, du, dv, h, w, c = mvol.shape
+        dt = self.dtype
         c1 = int(self.scale * 96)
         c2 = int(self.scale * 128)
         c3 = int(self.scale * 64)
@@ -132,14 +142,15 @@ class MatchingNet(nn.Module):
 
         x = mvol.reshape(b * du * dv, h, w, c)
 
-        x = ConvBlock(c1, norm_type=self.norm_type)(x, train, frozen_bn)
-        x = ConvBlock(c2, stride=2, norm_type=self.norm_type)(x, train, frozen_bn)
-        x = ConvBlock(c2, norm_type=self.norm_type)(x, train, frozen_bn)
-        x = ConvBlock(c3, norm_type=self.norm_type)(x, train, frozen_bn)
-        x = ConvBlockTransposed(c4, norm_type=self.norm_type, num_groups=4)(x, train, frozen_bn)
-        x = nn.Conv(1, (3, 3))(x)  # with bias, like the reference
+        x = ConvBlock(c1, norm_type=self.norm_type, dtype=dt)(x, train, frozen_bn)
+        x = ConvBlock(c2, stride=2, norm_type=self.norm_type, dtype=dt)(x, train, frozen_bn)
+        x = ConvBlock(c2, norm_type=self.norm_type, dtype=dt)(x, train, frozen_bn)
+        x = ConvBlock(c3, norm_type=self.norm_type, dtype=dt)(x, train, frozen_bn)
+        x = ConvBlockTransposed(c4, norm_type=self.norm_type, num_groups=4, dtype=dt)(x, train, frozen_bn)
+        x = nn.Conv(1, (3, 3), dtype=dt)(x)  # with bias, like the reference
 
-        cost = x.reshape(b, du, dv, h, w)
+        # the cost volume is the readout surface (softargmax/DAP): f32
+        cost = x.reshape(b, du, dv, h, w).astype(jnp.float32)
         return cost.transpose(0, 3, 4, 1, 2)  # (B, H, W, du, dv)
 
 

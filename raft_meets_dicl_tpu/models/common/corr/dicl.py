@@ -7,6 +7,8 @@ per displacement (displacements ride the batch axis through the convs), and
 apply the displacement-aware projection.
 """
 
+from typing import Any
+
 import flax.linen as nn
 
 from ..blocks.dicl import DisplacementAwareProjection, MatchingNet
@@ -27,6 +29,7 @@ class CorrelationModule(nn.Module):
     dap_init: str = "identity"
     norm_type: str = "batch"
     mnet_scale: float = 1
+    dtype: Any = None
 
     @property
     def output_dim(self):
@@ -38,10 +41,13 @@ class CorrelationModule(nn.Module):
 
         window = sample_window(f2, coords, self.radius)
         mvol = stack_pair(f1, window)  # (B, du, dv, H, W, 2C)
+        if self.dtype is not None:
+            mvol = mvol.astype(self.dtype)
 
-        cost = MatchingNet(norm_type=self.norm_type, scale=self.mnet_scale)(
+        cost = MatchingNet(norm_type=self.norm_type, scale=self.mnet_scale,
+                           dtype=self.dtype)(
             mvol, train, frozen_bn
-        )  # (B, H, W, du, dv)
+        )  # (B, H, W, du, dv) float32
 
         if dap:
             cost = DisplacementAwareProjection(

@@ -114,14 +114,18 @@ class RaftPlusDiclCtfModule(nn.Module):
     share_dicl: bool = False
     share_rnn: bool = True
     upsample_hidden: str = "none"
+    mixed_precision: bool = False
     remat: bool = True
     unroll: bool = False
 
-    def _make_cmod(self):
+    def _make_cmod(self, dtype=None):
+        kwargs = dict(self.corr_args or {})
+        if dtype is not None and self.corr_type == "dicl":
+            kwargs["dtype"] = dtype
         return corr_mod.make_cmod(
             self.corr_type, self.corr_channels, radius=self.corr_radius,
             dap_init=self.dap_init, norm_type=self.mnet_norm,
-            **(self.corr_args or {}),
+            **kwargs,
         )
 
     def _make_reg(self):
@@ -138,6 +142,16 @@ class RaftPlusDiclCtfModule(nn.Module):
         cdim = self.context_channels
         b, h, w = img1.shape[0], img1.shape[1], img1.shape[2]
 
+        # bf16 compute policy (TPU-native analog of the reference's raft
+        # autocast, extended to the ctf family): encoders, matching nets,
+        # and update blocks run bf16; cost volumes, coords/flow arithmetic,
+        # and the Up8 flow window stay float32
+        dt = jnp.bfloat16 if self.mixed_precision else None
+        enc_kw = {"dtype": dt} if dt is not None and \
+            self.encoder_type == "raft" else {}
+        ctx_kw = {"dtype": dt} if dt is not None and \
+            self.context_type == "raft" else {}
+
         iterations = tuple(iterations or _DEFAULT_ITERATIONS[self.levels])
         assert len(iterations) == self.levels
 
@@ -146,11 +160,11 @@ class RaftPlusDiclCtfModule(nn.Module):
 
         fnet = _PYRAMIDS[self.levels](
             self.encoder_type, output_dim=self.corr_channels,
-            norm_type=self.encoder_norm, dropout=0,
+            norm_type=self.encoder_norm, dropout=0, **enc_kw,
         )
         cnet = _PYRAMIDS[self.levels](
             self.context_type, output_dim=hdim + cdim,
-            norm_type=self.context_norm, dropout=0,
+            norm_type=self.context_norm, dropout=0, **ctx_kw,
         )
 
         f1, f2 = fnet((img1, img2), train, frozen_bn)  # finest-first tuples
@@ -162,21 +176,21 @@ class RaftPlusDiclCtfModule(nn.Module):
         # shared-or-per-level submodules (reference :40-78); flax modules
         # created once are parameter-shared on repeated calls
         if self.share_dicl:
-            shared_cmod, shared_reg = self._make_cmod(), self._make_reg()
+            shared_cmod, shared_reg = self._make_cmod(dt), self._make_reg()
             cmods = {lvl: shared_cmod for lvl in level_ids}
             regs = {lvl: shared_reg for lvl in level_ids}
         else:
-            cmods = {lvl: self._make_cmod() for lvl in level_ids}
+            cmods = {lvl: self._make_cmod(dt) for lvl in level_ids}
             regs = {lvl: self._make_reg() for lvl in level_ids}
 
         if self.share_rnn:
-            shared_update = BasicUpdateBlock(hdim)
+            shared_update = BasicUpdateBlock(hdim, dtype=dt)
             shared_hup = hsup.make_hidden_state_upsampler(
                 self.upsample_hidden, hdim)
             updates = {lvl: shared_update for lvl in level_ids}
             hups = {lvl: shared_hup for lvl in level_ids[1:]}
         else:
-            updates = {lvl: BasicUpdateBlock(hdim) for lvl in level_ids}
+            updates = {lvl: BasicUpdateBlock(hdim, dtype=dt) for lvl in level_ids}
             hups = {
                 lvl: hsup.make_hidden_state_upsampler(self.upsample_hidden, hdim)
                 for lvl in level_ids[1:]
@@ -184,7 +198,8 @@ class RaftPlusDiclCtfModule(nn.Module):
 
         # remat'd batched convex upsampler, pinned name for checkpoint
         # stability (the wrapper would otherwise prefix the module path)
-        upnet8 = nn.remat(Up8Network, prevent_cse=False)(name="Up8Network_0")
+        upnet8 = nn.remat(Up8Network, prevent_cse=False)(
+            dtype=dt, name="Up8Network_0")
 
         # the lifted scan broadcasts batch_stats read-only; when batch norm
         # actually trains (rare — stages default to freeze_batchnorm) the
@@ -314,6 +329,7 @@ class _CtfModel(Model):
 
         p = cfg["parameters"]
         return cls(
+            mixed_precision=bool(p.get("mixed-precision", False)),
             corr_radius=p.get("corr-radius", 4),
             corr_channels=p.get("corr-channels", 32),
             context_channels=p.get("context-channels", 128),
@@ -342,8 +358,9 @@ class _CtfModel(Model):
                  mnet_norm="batch", encoder_type="raft", context_type="raft",
                  share_dicl=False, share_rnn=True, corr_type="dicl",
                  corr_args={}, corr_reg_type="softargmax", corr_reg_args={},
-                 upsample_hidden="none", arguments={}, on_epoch_args={},
-                 on_stage_args={"freeze_batchnorm": True}):
+                 upsample_hidden="none", mixed_precision=False, arguments={},
+                 on_epoch_args={}, on_stage_args={"freeze_batchnorm": True}):
+        self.mixed_precision = mixed_precision
         self.corr_radius = corr_radius
         self.corr_channels = corr_channels
         self.context_channels = context_channels
@@ -374,6 +391,7 @@ class _CtfModel(Model):
                 corr_args=dict(corr_args), corr_reg_type=corr_reg_type,
                 corr_reg_args=dict(corr_reg_args), share_dicl=share_dicl,
                 share_rnn=share_rnn, upsample_hidden=upsample_hidden,
+                mixed_precision=mixed_precision,
             ),
             arguments=arguments,
             on_epoch_arguments=on_epoch_args,
@@ -392,6 +410,7 @@ class _CtfModel(Model):
         return {
             "type": self.type,
             "parameters": {
+                "mixed-precision": self.mixed_precision,
                 "corr-radius": self.corr_radius,
                 "corr-channels": self.corr_channels,
                 "context-channels": self.context_channels,

@@ -49,17 +49,33 @@ def main():
     result["peak_hbm_gib"] = round(peak / 2**30, 2)
 
     if args.try_baseline:
-        try:
-            pairs_b, peak_b = bench._measure(
-                {"type": "raft/baseline",
-                 "parameters": {"mixed-precision": True}},
-                {"type": "raft/sequence"},
-                1, args.height, args.width, {"iterations": args.iters},
-                args.steps)
+        # separate process: peak_bytes_in_use is a process-lifetime
+        # high-water mark, so measuring in-process would report
+        # max(fs_peak, baseline_peak)
+        import subprocess
+
+        code = (
+            "import sys, json; sys.path.insert(0, {repo!r}); import bench; "
+            "print(json.dumps(bench._measure("
+            "{{'type': 'raft/baseline', "
+            "'parameters': {{'mixed-precision': True}}}}, "
+            "{{'type': 'raft/sequence'}}, 1, {h}, {w}, "
+            "{{'iterations': {it}}}, {st})))"
+        ).format(repo=str(Path(__file__).parent.parent), h=args.height,
+                 w=args.width, it=args.iters, st=args.steps)
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True)
+        if proc.returncode == 0:
+            pairs_b, peak_b = json.loads(proc.stdout.strip().splitlines()[-1])
             result["baseline_value"] = round(pairs_b, 4)
             result["baseline_peak_hbm_gib"] = round(peak_b / 2**30, 2)
-        except Exception as e:  # noqa: BLE001 - the failure IS the datum
-            result["baseline_error"] = f"{type(e).__name__}: {str(e)[:120]}"
+        else:
+            # the failure IS the datum (OOM expected at 1080p)
+            tail = proc.stderr.strip().splitlines()
+            err = next((ln for ln in reversed(tail)
+                        if "Error" in ln or "RESOURCE" in ln),
+                       tail[-1] if tail else "unknown")
+            result["baseline_error"] = err[:160]
 
     print(json.dumps(result))
 

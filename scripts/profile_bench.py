@@ -36,15 +36,21 @@ def capture(trace_dir):
     model_params = {"mixed-precision": True} if model_ty == "raft/baseline" \
         else {}
     model_args = {"iterations": iters}
+    levels = 0
     if model_ty.startswith("raft+dicl/ctf"):
         levels = int(model_ty[-1])
         model_args = {"iterations": (iters,) * levels}
 
+    if model_ty.startswith("raft+dicl/ctf"):
+        loss_cfg = {"type": "raft+dicl/mlseq",
+                    "arguments": {"alpha": [0.38, 0.6, 1.0][:levels]
+                                  if levels <= 3 else [0.3, 0.38, 0.6, 1.0]}}
+    else:
+        loss_cfg = {"type": "raft/sequence"}
     spec = models.load({
         "name": "bench", "id": "bench",
         "model": {"type": model_ty, "parameters": model_params},
-        "loss": {"type": "raft/sequence" if model_ty == "raft/baseline"
-                 else "raft+dicl/mlseq"},
+        "loss": loss_cfg,
         "input": None,
     })
 
