@@ -3,8 +3,10 @@
 Mirrors the reference's FlyingThings3D training configuration (batch 6,
 720x400 crops, 12 GRU iterations, AdamW + grad clip —
 cfg/strategy/baseline/raft/s1-things.yaml) as a synthetic-data training-step
-benchmark on one chip. Prints ONE JSON line; the same line carries the
-thesis flagship's (raft+dicl/ctf-l3) throughput as an extra key.
+benchmark on one chip. Prints the primary metric as a JSON line as soon
+as it is measured, then (flagship enabled) a second, enriched JSON line
+with the thesis flagship's (raft+dicl/ctf-l3) throughput added —
+consumers read the LAST line, which is always the most complete.
 
 ``vs_baseline`` compares against the north-star target of 400 image-pairs/s
 on a v4-32 (32 chips) => 12.5 pairs/s/chip (BASELINE.json; the reference
@@ -100,6 +102,11 @@ def main():
         "vs_baseline": round(pairs_per_sec / BASELINE_PAIRS_PER_SEC_PER_CHIP, 3),
     }
 
+    # publish the primary metric immediately: the flagship measurement
+    # below adds a cold ~10 min compile, and a harness timeout must not
+    # lose this line (consumers read the LAST json line printed)
+    print(json.dumps(result), flush=True)
+
     if os.environ.get("BENCH_FLAGSHIP", "1") != "0":
         # the thesis flagship at a Things-like config (pyramid needs
         # multiples of 64) under the bf16 policy; a flagship failure must
@@ -120,7 +127,7 @@ def main():
         except Exception as e:  # noqa: BLE001 - report, don't lose the line
             result["ctf_l3_error"] = f"{type(e).__name__}: {str(e)[:120]}"
 
-    print(json.dumps(result))
+        print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":

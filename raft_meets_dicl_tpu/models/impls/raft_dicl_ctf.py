@@ -147,10 +147,18 @@ class RaftPlusDiclCtfModule(nn.Module):
         # and update blocks run bf16; cost volumes, coords/flow arithmetic,
         # and the Up8 flow window stay float32
         dt = jnp.bfloat16 if self.mixed_precision else None
-        enc_kw = {"dtype": dt} if dt is not None and \
-            self.encoder_type == "raft" else {}
-        ctx_kw = {"dtype": dt} if dt is not None and \
-            self.context_type == "raft" else {}
+        if dt is not None and (self.encoder_type != "raft"
+                               or self.context_type != "raft"
+                               or self.corr_type != "dicl"):
+            # silently running parts in f32 would fake the policy
+            raise ValueError(
+                "mixed-precision is only plumbed through the raft encoders "
+                "and the dicl correlation module; got encoder-type="
+                f"'{self.encoder_type}', context-type='{self.context_type}',"
+                f" corr-type='{self.corr_type}'"
+            )
+        enc_kw = {"dtype": dt} if dt is not None else {}
+        ctx_kw = {"dtype": dt} if dt is not None else {}
 
         iterations = tuple(iterations or _DEFAULT_ITERATIONS[self.levels])
         assert len(iterations) == self.levels

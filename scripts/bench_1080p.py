@@ -11,17 +11,41 @@ training steps of raft/fs at the cfg/strategy/highres recipe's crop,
 reports throughput and peak HBM, and (optionally) demonstrates the
 baseline's behavior at the same config.
 
+Each measurement runs in its own subprocess: peak_bytes_in_use is a
+process-lifetime high-water mark, and a parent holding the chip would
+block the child on single-client TPU runtimes.
+
     python scripts/bench_1080p.py [--try-baseline]
 """
 
 import argparse
 import json
+import subprocess
 import sys
 from pathlib import Path
 
-sys.path.insert(0, str(Path(__file__).parent.parent))
+REPO = Path(__file__).parent.parent
 
-import bench  # noqa: E402  (the shared train-step measurement harness)
+
+def measure_subprocess(model_cfg, height, width, iters, steps):
+    """bench._measure in a fresh process; returns (pairs/s, peak_bytes)
+    or raises RuntimeError with the child's last error line."""
+    code = (
+        "import sys, json; sys.path.insert(0, {repo!r}); import bench; "
+        "print(json.dumps(bench._measure({model!r}, "
+        "{{'type': 'raft/sequence'}}, 1, {h}, {w}, "
+        "{{'iterations': {it}}}, {st})))"
+    ).format(repo=str(REPO), model=model_cfg, h=height, w=width,
+             it=iters, st=steps)
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True)
+    if proc.returncode != 0:
+        tail = proc.stderr.strip().splitlines()
+        err = next((ln for ln in reversed(tail)
+                    if "Error" in ln or "RESOURCE" in ln),
+                   tail[-1] if tail else "unknown")
+        raise RuntimeError(err[:160])
+    return json.loads(proc.stdout.strip().splitlines()[-1])
 
 
 def main():
@@ -41,41 +65,23 @@ def main():
         "unit": "image-pairs/sec/chip",
     }
 
-    pairs, peak = bench._measure(
+    pairs, peak = measure_subprocess(
         {"type": "raft/fs", "parameters": {"mixed-precision": True}},
-        {"type": "raft/sequence"},
-        1, args.height, args.width, {"iterations": args.iters}, args.steps)
+        args.height, args.width, args.iters, args.steps)
     result["value"] = round(pairs, 4)
     result["peak_hbm_gib"] = round(peak / 2**30, 2)
 
     if args.try_baseline:
-        # separate process: peak_bytes_in_use is a process-lifetime
-        # high-water mark, so measuring in-process would report
-        # max(fs_peak, baseline_peak)
-        import subprocess
-
-        code = (
-            "import sys, json; sys.path.insert(0, {repo!r}); import bench; "
-            "print(json.dumps(bench._measure("
-            "{{'type': 'raft/baseline', "
-            "'parameters': {{'mixed-precision': True}}}}, "
-            "{{'type': 'raft/sequence'}}, 1, {h}, {w}, "
-            "{{'iterations': {it}}}, {st})))"
-        ).format(repo=str(Path(__file__).parent.parent), h=args.height,
-                 w=args.width, it=args.iters, st=args.steps)
-        proc = subprocess.run([sys.executable, "-c", code],
-                              capture_output=True, text=True)
-        if proc.returncode == 0:
-            pairs_b, peak_b = json.loads(proc.stdout.strip().splitlines()[-1])
+        try:
+            pairs_b, peak_b = measure_subprocess(
+                {"type": "raft/baseline",
+                 "parameters": {"mixed-precision": True}},
+                args.height, args.width, args.iters, args.steps)
             result["baseline_value"] = round(pairs_b, 4)
             result["baseline_peak_hbm_gib"] = round(peak_b / 2**30, 2)
-        else:
+        except RuntimeError as e:
             # the failure IS the datum (OOM expected at 1080p)
-            tail = proc.stderr.strip().splitlines()
-            err = next((ln for ln in reversed(tail)
-                        if "Error" in ln or "RESOURCE" in ln),
-                       tail[-1] if tail else "unknown")
-            result["baseline_error"] = err[:160]
+            result["baseline_error"] = str(e)
 
     print(json.dumps(result))
 

@@ -33,19 +33,18 @@ def capture(trace_dir):
     width = int(os.environ.get("BENCH_WIDTH", "720"))
     iters = int(os.environ.get("BENCH_ITERS", "12"))
     model_ty = os.environ.get("BENCH_MODEL", "raft/baseline")
-    model_params = {"mixed-precision": True} if model_ty == "raft/baseline" \
-        else {}
-    model_args = {"iterations": iters}
-    levels = 0
+    # profile what bench.py measures: bf16 policy on both bench models
+    model_params = {"mixed-precision": True} \
+        if model_ty in ("raft/baseline",) or \
+        model_ty.startswith("raft+dicl/ctf") else {}
     if model_ty.startswith("raft+dicl/ctf"):
         levels = int(model_ty[-1])
         model_args = {"iterations": (iters,) * levels}
-
-    if model_ty.startswith("raft+dicl/ctf"):
+        # corpus level weights, finest-last (cfg/model/raft+dicl-*.yaml)
         loss_cfg = {"type": "raft+dicl/mlseq",
-                    "arguments": {"alpha": [0.38, 0.6, 1.0][:levels]
-                                  if levels <= 3 else [0.3, 0.38, 0.6, 1.0]}}
+                    "arguments": {"alpha": [0.23, 0.38, 0.6, 1.0][-levels:]}}
     else:
+        model_args = {"iterations": iters}
         loss_cfg = {"type": "raft/sequence"}
     spec = models.load({
         "name": "bench", "id": "bench",
