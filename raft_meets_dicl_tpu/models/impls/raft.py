@@ -25,9 +25,8 @@ import jax
 import jax.numpy as jnp
 
 from ...ops.corr import (
-    all_pairs_correlation,
-    correlation_pyramid,
-    lookup_pyramid,
+    correlation_pyramid_direct,
+    lookup_pyramid_levels,
     window_delta,
 )
 from ...ops.upsample import convex_upsample_8x
@@ -53,13 +52,20 @@ class SoftArgMaxFlowRegression(nn.Module):
 
     @nn.compact
     def __call__(self, corr):
-        b, h, w, _ = corr.shape
+        # ``corr`` is either the flat (B, H, W, L·K²) lookup or the
+        # per-level list of (B, H, W, K, K) windows (layout-copy-free path)
+        is_levels = isinstance(corr, (list, tuple))
+        b, h, w = corr[0].shape[:3] if is_levels else corr.shape[:3]
         k = 2 * self.radius + 1
-        delta = window_delta(self.radius, corr.dtype)
+        dtype = corr[0].dtype if is_levels else corr.dtype
+        delta = window_delta(self.radius, dtype)
 
         out = []
         for lvl in range(self.num_levels):
-            score = corr[..., lvl * k * k : (lvl + 1) * k * k]
+            if is_levels:
+                score = corr[lvl].reshape(b, h, w, k * k)
+            else:
+                score = corr[..., lvl * k * k : (lvl + 1) * k * k]
 
             if self.dap:
                 score = score.reshape(b, h, w, k, k)
@@ -83,44 +89,164 @@ def make_flow_regression(type, num_levels, radius, **kwargs):
     raise ValueError(f"unknown correlation module type '{type}'")
 
 
+class _WindowConv1x1(nn.Module):
+    """1x1 conv over concatenated correlation windows, without the concat.
+
+    Parameter-identical to ``nn.Conv(features, (1, 1))`` on the flat
+    (B, H, W, L·K²) lookup tensor (kernel (1, 1, L·K², features) + bias),
+    but accepts the per-level list of (B, H, W, K, K) windows and contracts
+    each level against its kernel slice directly — the flatten + concat the
+    flat form needs costs XLA tile-padded layout copies (a (…, 9, 9) minor
+    pair pads to (16, 128) tiles: 25x memory inflation, ~30 ms/step
+    profiled at the bench config). Flat tensors still work (shared zoo
+    callers pass them), so checkpoints are interchangeable.
+    """
+
+    features: int
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        levels = x if isinstance(x, (list, tuple)) else None
+        if levels is not None:
+            in_features = sum(l.shape[-2] * l.shape[-1] for l in levels)
+            pdtype = levels[0].dtype
+        else:
+            in_features = x.shape[-1]
+            pdtype = x.dtype
+
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (1, 1, in_features, self.features))
+        bias = self.param("bias", nn.initializers.zeros_init(),
+                          (self.features,))
+
+        dt = self.dtype or jnp.promote_types(pdtype, kernel.dtype)
+        k2 = kernel.reshape(in_features, self.features).astype(dt)
+
+        if levels is None:
+            y = jnp.einsum("bhwc,cf->bhwf", x.astype(dt), k2,
+                           preferred_element_type=jnp.float32)
+        else:
+            y = 0.0
+            offset = 0
+            for lvl in levels:
+                ka, kk = lvl.shape[-2], lvl.shape[-1]
+                kl = k2[offset : offset + ka * kk].reshape(ka, kk,
+                                                           self.features)
+                y = y + jnp.einsum("bhwak,akf->bhwf", lvl.astype(dt), kl,
+                                   preferred_element_type=jnp.float32)
+                offset += ka * kk
+        return y.astype(dt) + bias.astype(dt)
+
+
 class BasicMotionEncoder(nn.Module):
-    """Combine correlation features and current flow into motion features."""
+    """Combine correlation features and current flow into motion features.
+
+    ``corr`` may be the flat (B, H, W, L·K²) lookup tensor or the
+    per-level window list (see ``_WindowConv1x1``); parameters are
+    identical either way (conv names match the reference's
+    convc1/convc2/convf1/convf2/conv, chkpt_convert rules).
+    """
 
     dtype: Any = None
 
     @nn.compact
     def __call__(self, flow, corr):
         dt = self.dtype
-        cor = nn.relu(nn.Conv(256, (1, 1), dtype=dt)(corr))
-        cor = nn.relu(nn.Conv(192, (3, 3), dtype=dt)(cor))
+        cor = nn.relu(_WindowConv1x1(256, dtype=dt, name="Conv_0")(corr))
+        cor = nn.relu(nn.Conv(192, (3, 3), dtype=dt, name="Conv_1")(cor))
 
-        flo = nn.relu(nn.Conv(128, (7, 7), dtype=dt)(flow))
-        flo = nn.relu(nn.Conv(64, (3, 3), dtype=dt)(flo))
+        flo = nn.relu(nn.Conv(128, (7, 7), dtype=dt, name="Conv_2")(flow))
+        flo = nn.relu(nn.Conv(64, (3, 3), dtype=dt, name="Conv_3")(flo))
 
         combined = jnp.concatenate((cor, flo), axis=-1)
-        combined = nn.relu(nn.Conv(128 - 2, (3, 3), dtype=dt)(combined))
+        combined = nn.relu(nn.Conv(128 - 2, (3, 3), dtype=dt,
+                                   name="Conv_4")(combined))
 
         flow = flow.astype(combined.dtype)
         return jnp.concatenate((combined, flow), axis=-1)  # 128 channels
 
 
+class _ConvParams(nn.Module):
+    """Holds an ``nn.Conv``-compatible kernel + bias without applying them.
+
+    Lets sibling convolutions with a shared input be merged into one conv
+    call (concatenated output channels) while the checkpoint tree keeps the
+    reference's one-param-set-per-conv structure.
+    """
+
+    features: int
+    kernel_size: Tuple[int, int]
+
+    @nn.compact
+    def __call__(self, in_features):
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (*self.kernel_size, in_features, self.features))
+        bias = self.param("bias", nn.initializers.zeros_init(),
+                          (self.features,))
+        return kernel, bias
+
+
 class SepConvGru(nn.Module):
-    """Separable (1x5 then 5x1) convolutional GRU."""
+    """Separable (1x5 then 5x1) convolutional GRU.
+
+    The z and r gates read the same (h, x) concat, so their convs run as
+    one merged conv with doubled output channels (fewer, larger MXU ops:
+    the scan body executes 12x per step and small-op overhead dominates
+    the profile). Parameters stay per-gate (Conv_0/Conv_1 = z1/r1,
+    Conv_3/Conv_4 = z2/r2 — the reference's convz1/convr1/convz2/convr2,
+    chkpt_convert rules), merged only at apply time.
+    """
 
     hidden_dim: int = 128
     dtype: Any = None
 
     @nn.compact
     def __call__(self, h, x):
+        from jax.ad_checkpoint import checkpoint_name
+
+        def conv(inp, w, b=None):
+            out = jax.lax.conv_general_dilated(
+                inp, w, (1, 1), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            return out if b is None else out + b
+
         dt = self.dtype
-        for ksize in ((1, 5), (5, 1)):
-            hx = jnp.concatenate((h, x), axis=-1)
-            z = nn.sigmoid(nn.Conv(self.hidden_dim, ksize, dtype=dt)(hx))
-            r = nn.sigmoid(nn.Conv(self.hidden_dim, ksize, dtype=dt)(hx))
-            q = jnp.tanh(
-                nn.Conv(self.hidden_dim, ksize, dtype=dt)(
-                    jnp.concatenate((r * h, x), axis=-1))
-            )
+        hd = self.hidden_dim
+        for i, ksize in enumerate(((1, 5), (5, 1))):
+            zk, zb = _ConvParams(hd, ksize, name=f"Conv_{3 * i}")(
+                h.shape[-1] + x.shape[-1])
+            rk, rb = _ConvParams(hd, ksize, name=f"Conv_{3 * i + 1}")(
+                h.shape[-1] + x.shape[-1])
+            qk, qb = _ConvParams(hd, ksize, name=f"Conv_{3 * i + 2}")(
+                h.shape[-1] + x.shape[-1])
+
+            cdt = dt or zk.dtype
+            hc = h.astype(cdt)
+            xc = x.astype(cdt)
+
+            # gate convs split along the input-channel axis: the
+            # (h, x)-concat conv equals conv(h, W_h) + conv(x, W_x) by
+            # linearity. The x-half outputs are checkpoint-named so the
+            # remat policy saves them instead of recomputing in the
+            # backward pass — the x convs are 2/3 of the gate FLOPs and
+            # their saved activations are small (measured net win at the
+            # bench config); it also skips the h/x concat materialization.
+            zrk_h = jnp.concatenate((zk[:, :, :hd], rk[:, :, :hd]),
+                                    axis=-1).astype(cdt)
+            zrk_x = jnp.concatenate((zk[:, :, hd:], rk[:, :, hd:]),
+                                    axis=-1).astype(cdt)
+            zrb = jnp.concatenate((zb, rb)).astype(cdt)
+
+            zr_x = checkpoint_name(conv(xc, zrk_x), "gru_gate_x")
+            zr = conv(hc, zrk_h) + zr_x + zrb
+            z = nn.sigmoid(zr[..., :hd])
+            r = nn.sigmoid(zr[..., hd:])
+
+            q_x = checkpoint_name(conv(xc, qk[:, :, hd:].astype(cdt)),
+                                  "gru_gate_x")
+            q = jnp.tanh(conv((r * h).astype(cdt), qk[:, :, :hd].astype(cdt))
+                         + q_x + qb.astype(cdt))
             h = (1.0 - z) * h + z * q
 
         return h
@@ -207,13 +333,17 @@ class _RaftStep(nn.Module):
         coords1 = jax.lax.stop_gradient(coords1)
         flow = coords1 - coords0
 
-        corr = lookup_pyramid(pyramid, coords1, self.corr_radius, self.mask_costs)
+        # per-level list form: the flatten-to-K² + level concat the flat
+        # lookup would do costs tile-padding layout copies (~30 ms/step);
+        # every consumer contracts the window axes anyway
+        corr = lookup_pyramid_levels(pyramid, coords1, self.corr_radius,
+                                     self.mask_costs)
         # named so the remat policy can save the lookup output: recomputing
         # the windowed einsums in the backward pass costs more than the
         # (B, H/8, W/8, L·(2r+1)²) buffer per iteration it saves
         from jax.ad_checkpoint import checkpoint_name
 
-        corr = checkpoint_name(corr, "corr_features")
+        corr = [checkpoint_name(lvl, "corr_features") for lvl in corr]
 
         # always *call* the readout so its params exist regardless of the
         # static switch (per-stage overrides / checkpoint compatibility);
@@ -289,13 +419,11 @@ class RaftModule(nn.Module):
         # measured realization on-chip at training crops (the feature-space
         # alternative — ops.pallas.windowed_corr_pyramid, identical math by
         # linearity of pooling/interp in f2 — is what raft/fs uses where
-        # the O(H²W²) volume cannot exist at all)
-        corr_full = all_pairs_correlation(fmap1, fmap2)
-        if dt is not None:
-            # keep the O(H²W²) volume in bf16: halves HBM footprint and
-            # lookup read traffic; the lookup einsums accumulate in f32
-            corr_full = corr_full.astype(dt)
-        pyramid = tuple(correlation_pyramid(corr_full, self.corr_levels))
+        # the O(H²W²) volume cannot exist at all). Each pyramid level is a
+        # direct einsum against pooled f2 (bf16 under the policy: halves
+        # volume HBM traffic; lookup einsums still accumulate in f32).
+        pyramid = tuple(correlation_pyramid_direct(
+            fmap1, fmap2, self.corr_levels, dtype=dt))
 
         ctx = cnet(img1, train, frozen_bn)
         h = jnp.tanh(ctx[..., :hdim])
@@ -314,7 +442,7 @@ class RaftModule(nn.Module):
             body = nn.remat(
                 _RaftStep, prevent_cse=False,
                 policy=jax.checkpoint_policies.save_only_these_names(
-                    "corr_features"),
+                    "corr_features", "gru_gate_x"),
             )
         else:
             body = _RaftStep

@@ -55,6 +55,41 @@ def correlation_pyramid(corr, num_levels=4):
     return pyramid
 
 
+def _pool2x_spatial(fmap):
+    """Average-pool the H, W axes of a (B, H, W, C) feature map by 2
+    (floor semantics like ``_pool2x_last2``). Accumulates in float32."""
+    b, h, w, c = fmap.shape
+    x = fmap[:, : h // 2 * 2, : w // 2 * 2].astype(jnp.float32)
+    x = x.reshape(b, h // 2, 2, w // 2, 2, c).mean(axis=(2, 4))
+    return x.astype(fmap.dtype)
+
+
+def correlation_pyramid_direct(fmap1, fmap2, num_levels=4, dtype=None):
+    """Pyramid of all-pairs volumes against progressively pooled frame-2 maps.
+
+    Mathematically identical to ``correlation_pyramid(all_pairs_correlation
+    (fmap1, fmap2))`` — average pooling commutes with the dot product by
+    linearity — but TPU-native: each level is one large MXU einsum against a
+    tiny pooled feature map, instead of reshape/mean chains over the
+    O(H²W²) volume (whose oddly-tiled intermediates cost layout copies in
+    both passes; profiled ~8 ms/step at the bench config). ``dtype`` casts
+    each level after the f32-accumulated einsum (bf16 under the mixed
+    policy halves volume HBM traffic).
+    """
+    c = fmap1.shape[-1]
+    inv_sqrt_c = 1.0 / jnp.sqrt(jnp.asarray(c, jnp.float32))
+
+    pyramid = []
+    f2 = fmap2
+    for lvl in range(num_levels):
+        corr = jnp.einsum("bijc,bklc->bijkl", fmap1, f2,
+                          preferred_element_type=jnp.float32) * inv_sqrt_c
+        pyramid.append(corr.astype(dtype) if dtype is not None else corr)
+        if lvl + 1 < num_levels:
+            f2 = _pool2x_spatial(f2)
+    return pyramid
+
+
 def window_offsets(radius, dtype=jnp.float32):
     """(2r+1,) per-axis window offsets: -r, ..., 0, ..., r.
 
@@ -125,6 +160,31 @@ def _lookup_level(corr, x, y):
                       preferred_element_type=jnp.float32)
 
 
+def lookup_pyramid_levels(pyramid, coords, radius, mask_costs=()):
+    """Windowed lookup, one (B, H, W, K_dx, K_dy) tensor per pyramid level.
+
+    The un-flattened variant of ``lookup_pyramid``: consumers that contract
+    the window axes anyway (the motion encoder's 1x1 conv, the soft-argmax
+    readout) take the per-level list directly — reshaping (K, K) minor dims
+    to K² and concatenating levels forces XLA layout copies of
+    (8,128)-tile-padded windows, profiled at ~30 ms/step at the bench
+    config.
+    """
+    d = window_offsets(radius, coords.dtype)
+
+    out = []
+    for i, corr in enumerate(pyramid):
+        centers = coords / (2**i)
+        x = centers[..., 0:1] + d  # (B, H, W, K) window positions along W2
+        y = centers[..., 1:2] + d  # (B, H, W, K) window positions along H2
+        level = _lookup_level(corr, x, y)  # (..., K_dx, K_dy)
+        if i + 3 in mask_costs:
+            level = jnp.zeros_like(level)
+        out.append(level)
+
+    return out
+
+
 def lookup_pyramid(pyramid, coords, radius, mask_costs=()):
     """Windowed lookup over all pyramid levels (reference raft.py:49-95).
 
@@ -134,20 +194,9 @@ def lookup_pyramid(pyramid, coords, radius, mask_costs=()):
     downsampling octave), matching the reference's convention (raft.py:86).
     """
     k = 2 * radius + 1
-    d = window_offsets(radius, coords.dtype)
-
-    out = []
-    for i, corr in enumerate(pyramid):
-        centers = coords / (2**i)
-        x = centers[..., 0:1] + d  # (B, H, W, K) window positions along W2
-        y = centers[..., 1:2] + d  # (B, H, W, K) window positions along H2
-        level = _lookup_level(corr, x, y)  # (..., K_dx, K_dy)
-        level = level.reshape(*coords.shape[:3], k * k)
-        if i + 3 in mask_costs:
-            level = jnp.zeros_like(level)
-        out.append(level)
-
-    return jnp.concatenate(out, axis=-1)
+    levels = lookup_pyramid_levels(pyramid, coords, radius, mask_costs)
+    return jnp.concatenate(
+        [lvl.reshape(*coords.shape[:3], k * k) for lvl in levels], axis=-1)
 
 
 class CorrVolume:

@@ -505,7 +505,15 @@ def _wcp_reference(f1, f2_levels, coords, radius):
 def _wcp_fits_vmem(f1, f2_levels, radius):
     """Static shape check: the kernel holds one (b, i)-row of state plus
     every padded f2 map in VMEM; beyond ~64M even the raised compiler
-    budget cannot place it, so oversized shapes take the XLA path."""
+    budget cannot place it, so oversized shapes take the XLA path.
+
+    Also gates on radius: the widened slab width _XW covers the
+    (k+1)-lane window plus the ≤7-lane alignment shift only for
+    radius ≤ 7 — beyond that the x-selection matrix would silently drop
+    the last lerp lane, so larger radii take the (exact) XLA path too.
+    """
+    if radius > 7:
+        return False
     lo, hi_y, hi_x = _wcp_pads(radius)
     k = 2 * radius + 1
     n_lvl = len(f2_levels)
