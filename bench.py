@@ -45,9 +45,10 @@ def _measure(model_cfg, loss_cfg, batch, height, width, model_args, steps):
     valid = jnp.ones((batch, height, width), bool)
 
     init_args = dict(model_args)
-    init_args["iterations"] = (
-        (1,) * len(model_args["iterations"])
-        if isinstance(model_args["iterations"], tuple) else 1)
+    if "iterations" in init_args:
+        init_args["iterations"] = (
+            (1,) * len(model_args["iterations"])
+            if isinstance(model_args["iterations"], tuple) else 1)
     variables = model.init(jax.random.PRNGKey(0), img1[:1], img2[:1],
                            **init_args)
 
@@ -128,6 +129,53 @@ def main():
             result["ctf_l3_error"] = f"{type(e).__name__}: {str(e)[:120]}"
 
         print(json.dumps(result), flush=True)
+
+    if os.environ.get("BENCH_ZOO", "1") != "0":
+        # one throughput line per model family at its reference training
+        # shape, so a perf regression anywhere in the zoo is visible —
+        # not just in the headline models. The enriched JSON line reprints
+        # after every measurement: a harness timeout keeps what finished.
+        cpu = jax.default_backend() == "cpu"
+        zoo = [
+            # raft/fs: the windowed (no-volume) lookup strategy, bf16
+            ("raft_fs", {"type": "raft/fs",
+                         "parameters": {"mixed-precision": True}},
+             {"type": "raft/sequence"},
+             (1, 64, 96, {"iterations": 2}, 2) if cpu else
+             (6, 400, 720, {"iterations": 12}, 3)),
+            # raft/sl-ctf-l3: single-lookup coarse-to-fine (thesis ablation)
+            ("raft_sl_ctf3", {"type": "raft/sl-ctf-l3", "parameters": {}},
+             {"type": "raft+dicl/mlseq",
+              "arguments": {"gamma": 0.85, "alpha": [0.38, 0.6, 1.0]}},
+             (1, 64, 128, {"iterations": (2, 1, 1)}, 2) if cpu else
+             (6, 384, 704, {"iterations": (4, 3, 3)}, 3)),
+            # raft+dicl/ml: multi-level DICL lookup, single RAFT loop.
+            # Reduced shape: the full Things config (b6, 384x704, 12 iters)
+            # crashes the TPU compiler service on this model's multi-level
+            # graph — b2/256x448/6 is the largest verified-compiling config
+            ("raft_dicl_ml", {"type": "raft+dicl/ml", "parameters": {}},
+             {"type": "raft/sequence"},
+             (1, 64, 128, {"iterations": 2}, 2) if cpu else
+             (2, 256, 448, {"iterations": 6}, 3)),
+            # dicl/baseline: pure DICL coarse-to-fine (GA-Net encoder)
+            ("dicl_baseline",
+             {"type": "dicl/baseline",
+              "parameters": {"displacement-range": {
+                  f"level-{lvl}": [3, 3] for lvl in range(2, 7)}}},
+             {"type": "dicl/multiscale",
+              "arguments": {"weights": [1.0, 0.8, 0.75, 0.6, 0.5,
+                                        0.4, 0.5, 0.4, 0.5, 0.4],
+                            "ord": 2}},
+             (1, 128, 128, {}, 2) if cpu else (6, 384, 768, {}, 3)),
+        ]
+        for name, model_cfg, loss_cfg, (zb, zh, zw, zargs, zsteps) in zoo:
+            try:
+                pairs, _ = _measure(model_cfg, loss_cfg, zb, zh, zw,
+                                    zargs, zsteps)
+                result[f"{name}_pairs_per_sec"] = round(pairs, 3)
+            except Exception as e:  # noqa: BLE001
+                result[f"{name}_error"] = f"{type(e).__name__}: {str(e)[:120]}"
+            print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":

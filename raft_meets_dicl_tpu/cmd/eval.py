@@ -41,6 +41,15 @@ def evaluate(args):
     devices = select_devices(args.device, args.device_ids)
     jax.config.update("jax_default_device", devices[0])
 
+    # multi-device selection shards the eval batch over a data mesh (the
+    # reference wraps eval in nn.DataParallel, src/cmd/eval.py:144-145)
+    mesh = None
+    if len(devices) > 1:
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.asarray(devices), ("data",))
+        logging.info(f"evaluating data-parallel over {len(devices)} devices")
+
     # model (a full training config's model section is accepted too)
     logging.info(f"loading model specification, file='{args.model}'")
     model_cfg = utils.config.load(args.model)
@@ -104,7 +113,7 @@ def evaluate(args):
     output = []
     ctx_m = metrics.MetricContext()
 
-    for sample in evaluation.evaluate(model, variables, loader):
+    for sample in evaluation.evaluate(model, variables, loader, mesh=mesh):
         target = sample.target[None] if sample.target is not None else None
         valid = sample.valid[None] if sample.valid is not None else None
         est = sample.final[None]

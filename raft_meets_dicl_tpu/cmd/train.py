@@ -79,6 +79,17 @@ def select_devices(device=None, device_ids=None):
     """
     import jax
 
+    if device:
+        # make the requested platform the jax default too — site
+        # configuration may pin a different platform, and only a
+        # pre-backend-init config update lets e.g. `--device cpu` on an
+        # accelerator host pick up XLA_FLAGS like
+        # --xla_force_host_platform_device_count
+        try:
+            jax.config.update("jax_platforms", device)
+        except RuntimeError:
+            pass  # backend already initialized; fall through to filtering
+
     devices = jax.devices(device) if device else jax.devices()
 
     if device_ids:
@@ -162,10 +173,16 @@ def _train(args):
     else:
         # secondary processes compute, they don't publish: artifacts go
         # to a scratch dir (checkpoint writes themselves are gated to the
-        # primary in CheckpointManager.create), logging stays on console
+        # primary in CheckpointManager.create), logging stays on console.
+        # The scratch dir is removed when the process exits — worker hosts
+        # otherwise accumulate one per run.
+        import atexit
+        import shutil
         import tempfile
 
-        path_out = Path(tempfile.mkdtemp(prefix="train-secondary-"))
+        scratch = tempfile.mkdtemp(prefix="train-secondary-")
+        atexit.register(shutil.rmtree, scratch, ignore_errors=True)
+        path_out = Path(scratch)
         utils.logging.setup()
     logging.info(f"starting: time is {timestamp}, writing to '{path_out}'")
     logging.info(f"description: {args.comment if args.comment else '<not available>'}")

@@ -63,25 +63,43 @@ def static_args_key(args):
     return tuple(parts)
 
 
-def _cache_key(model, model_args):
+def _cache_key(model, model_args, mesh=None):
     args_key = static_args_key(model_args)
-    return None if args_key is None else (id(model), args_key)
+    if args_key is None:
+        return None
+    mesh_key = None if mesh is None else tuple(d.id for d in mesh.devices.flat)
+    return (id(model), args_key, mesh_key)
 
 
-def make_eval_fn(model, model_args=None):
-    """Jitted ``(variables, img1, img2) -> (raw_output, final_flow)``."""
+def make_eval_fn(model, model_args=None, mesh=None):
+    """Jitted ``(variables, img1, img2) -> (raw_output, final_flow)``.
+
+    With ``mesh`` (a 1-D ``jax.sharding.Mesh`` over a ``data`` axis) the
+    step runs SPMD like the training step: variables replicated, batch
+    sharded on the leading axis (reference wraps eval in nn.DataParallel,
+    src/cmd/eval.py:144-145) — callers must pad batches to a multiple of
+    the mesh size (``evaluate`` does).
+    """
     model_args = dict(model_args or {})
-    key = _cache_key(model, model_args)
+    key = _cache_key(model, model_args, mesh)
     if key is not None and key in _EVAL_FN_CACHE:
         return _EVAL_FN_CACHE[key]
 
     adapter = model.get_adapter()
 
-    @jax.jit
     def step(variables, img1, img2):
         out = model.apply(variables, img1, img2, train=False, **model_args)
         result = adapter.wrap_result(out, img1.shape[1:3])
         return out, result.final()
+
+    if mesh is None:
+        step = jax.jit(step)
+    else:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        repl = NamedSharding(mesh, P())
+        data = NamedSharding(mesh, P("data"))
+        step = jax.jit(step, in_shardings=(repl, data, data))
 
     if key is not None:
         while len(_EVAL_FN_CACHE) >= _EVAL_FN_CACHE_MAX:
@@ -91,16 +109,21 @@ def make_eval_fn(model, model_args=None):
 
 
 def evaluate(model, variables, data, model_args=None, show_progress=True,
-             eval_fn=None):
+             eval_fn=None, mesh=None):
     """Yield an ``EvalSample`` per dataset sample.
 
     ``data`` iterates batches ``(img1, img2, flow, valid, meta)`` in NHWC
     numpy (a ``models.input.Loader`` or any compatible iterable).
     Reference contract: src/evaluation/evaluator.py:4-37. Pass a prebuilt
     ``eval_fn`` (from ``make_eval_fn``) to control caching explicitly.
+
+    With ``mesh`` the batch is sharded over the mesh's ``data`` axis;
+    short batches are padded by repeating the last sample (padded outputs
+    are dropped — only real samples are yielded).
     """
     adapter = model.get_adapter()
-    step = eval_fn if eval_fn is not None else make_eval_fn(model, model_args)
+    step = (eval_fn if eval_fn is not None
+            else make_eval_fn(model, model_args, mesh=mesh))
 
     if show_progress:
         data = utils.logging.progress(data, unit="batch", leave=False)
@@ -108,7 +131,16 @@ def evaluate(model, variables, data, model_args=None, show_progress=True,
     for img1, img2, flow, valid, meta in data:
         batch = img1.shape[0]
 
-        out, final = step(variables, jnp.asarray(img1), jnp.asarray(img2))
+        j1, j2 = jnp.asarray(img1), jnp.asarray(img2)
+        if mesh is not None:
+            n = mesh.devices.size
+            pad = (-batch) % n
+            if pad:
+                reps = [1] * (j1.ndim - 1)
+                j1 = jnp.concatenate([j1, jnp.tile(j1[-1:], [pad] + reps)])
+                j2 = jnp.concatenate([j2, jnp.tile(j2[-1:], [pad] + reps)])
+
+        out, final = step(variables, j1, j2)
         out, final = jax.device_get((out, final))
 
         result = adapter.wrap_result(out, img1.shape[1:3])

@@ -284,6 +284,13 @@ class StrategyValidation(Validation):
             log.warn("no validation data specified, skipping this validation step")
             return
 
+        # multi-process: validation (and the checkpoint it triggers) is
+        # primary-only — metrics, logs, and checkpoint writes are all
+        # primary-owned, the val step emits no collectives to desync on,
+        # and duplicating the full sweep on every worker is wasted compute
+        if jax.process_count() > 1 and jax.process_index() != 0:
+            return
+
         chkpmetrics = {}
 
         for i, val in enumerate(stage.validation):
@@ -336,6 +343,14 @@ class StrategyValidation(Validation):
         samples = utils.logging.progress(data, unit="batch", leave=False, desc=desc)
 
         variables = ctx.train_variables()
+        if jax.process_count() > 1:
+            # params live as global-mesh-replicated arrays; localize them
+            # (committed to a local device, not host numpy — numpy leaves
+            # would re-upload per batch) so the process-local validation
+            # jit can't trip the partitioner into emitting global-mesh
+            # collectives the other processes would never join
+            variables = jax.device_put(jax.device_get(variables),
+                                       jax.local_devices()[0])
         ctx_m = metrics.MetricContext(lr=ctx.last_lr, params=variables["params"])
 
         for i, (img1, img2, flow, valid, meta) in enumerate(samples):

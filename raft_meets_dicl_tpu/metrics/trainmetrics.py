@@ -80,6 +80,12 @@ class _TreeMetric(Metric):
         if isinstance(self.params, dict):
             out = {}
             for group, prefixes in self.params.items():
+                # a group of exactly ['total'] passes the synthetic whole-
+                # tree aggregate through (the reference configs' convention,
+                # cfg/inspect/detailed-ctf3.yaml)
+                if list(prefixes) == ["total"]:
+                    out[group] = stats["total"]
+                    continue
                 # each leaf counts once even if several prefixes match, and
                 # the synthetic 'total' aggregate never joins a group
                 sel = [v for k, v in stats.items()

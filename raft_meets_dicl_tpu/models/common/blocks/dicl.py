@@ -17,14 +17,36 @@ TPU-native layout decisions:
 from typing import Any
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from ..norm import Norm2d
 from ..util import identity_1x1_init
 
 
+class _ConvKernel(nn.Module):
+    """Holds an ``nn.Conv``-compatible (bias-free) kernel without applying
+    it, so one parameter set can be applied as split partial convolutions."""
+
+    features: int
+    kernel_size: tuple
+
+    @nn.compact
+    def __call__(self, in_features):
+        return self.param("kernel", nn.initializers.lecun_normal(),
+                          (*self.kernel_size, in_features, self.features))
+
+
 class ConvBlock(nn.Module):
-    """conv → norm → relu (no conv bias, like the reference)."""
+    """conv → norm → relu (no conv bias, like the reference).
+
+    Input may also be a pair ``(shared, per_item)`` with shared (B, H, W,
+    C1) and per_item (B·N, H, W, C2): the conv then splits along its input
+    channels — conv(concat) = conv(shared) broadcast over N + conv(per_item)
+    by linearity — computing the shared half once instead of N times.
+    Parameters are identical to the concatenated form (kernel channels
+    ordered shared-first).
+    """
 
     c_out: int
     kernel_size: int = 3
@@ -36,17 +58,40 @@ class ConvBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, train=False, frozen_bn=False):
-        # explicit torch-convention padding (flax 'SAME' shifts strided
-        # convs by one pixel on even inputs)
-        x = nn.Conv(
-            self.c_out,
-            (self.kernel_size, self.kernel_size),
-            strides=self.stride,
-            kernel_dilation=self.dilation,
-            padding=self.dilation * (self.kernel_size // 2),
-            use_bias=False,
-            dtype=self.dtype,
-        )(x)
+        if isinstance(x, tuple):
+            shared, per_item = x
+            c1 = shared.shape[-1]
+            kernel = _ConvKernel(
+                self.c_out, (self.kernel_size, self.kernel_size),
+                name="Conv_0")(c1 + per_item.shape[-1])
+
+            dt = self.dtype or kernel.dtype
+            pad = self.dilation * (self.kernel_size // 2)
+
+            def conv(inp, kk):
+                return jax.lax.conv_general_dilated(
+                    inp.astype(dt), kk.astype(dt),
+                    (self.stride, self.stride), [(pad, pad), (pad, pad)],
+                    rhs_dilation=(self.dilation, self.dilation),
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+            ys = conv(shared, kernel[:, :, :c1])       # (B, h', w', c_out)
+            yp = conv(per_item, kernel[:, :, c1:])     # (B·N, h', w', c_out)
+            n = yp.shape[0] // ys.shape[0]
+            x = (yp.reshape(ys.shape[0], n, *yp.shape[1:])
+                 + ys[:, None]).reshape(yp.shape)
+        else:
+            # explicit torch-convention padding (flax 'SAME' shifts strided
+            # convs by one pixel on even inputs)
+            x = nn.Conv(
+                self.c_out,
+                (self.kernel_size, self.kernel_size),
+                strides=self.stride,
+                kernel_dilation=self.dilation,
+                padding=self.dilation * (self.kernel_size // 2),
+                use_bias=False,
+                dtype=self.dtype,
+            )(x)
         x = Norm2d(self.norm_type, self.num_groups, dtype=self.dtype)(
             x, train and not frozen_bn)
         return nn.relu(x)
@@ -125,6 +170,14 @@ class MatchingNet(nn.Module):
     Input ``(B, du, dv, H, W, C)`` (stacked feature pairs), output cost
     ``(B, H, W, du, dv)``. The displacement axes ride the batch dimension
     through the convs — one large batched conv instead of du*dv small ones.
+
+    Alternatively input may be the pair ``(f1, window)`` with f1
+    (B, H, W, C) and window (B, du, dv, H, W, C) *unstacked*: the first
+    conv then splits along its input channels — the f1 half is computed
+    once and broadcast over displacements instead of convolving the same
+    f1 values du·dv times (half the first conv's FLOPs, and the
+    (B, du, dv, H, W, C) f1 broadcast never materializes). Parameters are
+    identical to the stacked form.
     """
 
     norm_type: str = "batch"
@@ -133,16 +186,22 @@ class MatchingNet(nn.Module):
 
     @nn.compact
     def __call__(self, mvol, train=False, frozen_bn=False):
-        b, du, dv, h, w, c = mvol.shape
         dt = self.dtype
         c1 = int(self.scale * 96)
         c2 = int(self.scale * 128)
         c3 = int(self.scale * 64)
         c4 = int(self.scale * 32)
 
-        x = mvol.reshape(b * du * dv, h, w, c)
-
-        x = ConvBlock(c1, norm_type=self.norm_type, dtype=dt)(x, train, frozen_bn)
+        if isinstance(mvol, tuple):
+            f1, window = mvol
+            b, du, dv, h, w, c = window.shape
+            x = ConvBlock(c1, norm_type=self.norm_type, dtype=dt)(
+                (f1, window.reshape(b * du * dv, h, w, c)), train, frozen_bn)
+        else:
+            b, du, dv, h, w, c = mvol.shape
+            x = mvol.reshape(b * du * dv, h, w, c)
+            x = ConvBlock(c1, norm_type=self.norm_type, dtype=dt)(
+                x, train, frozen_bn)
         x = ConvBlock(c2, stride=2, norm_type=self.norm_type, dtype=dt)(x, train, frozen_bn)
         x = ConvBlock(c2, norm_type=self.norm_type, dtype=dt)(x, train, frozen_bn)
         x = ConvBlock(c3, norm_type=self.norm_type, dtype=dt)(x, train, frozen_bn)

@@ -16,7 +16,6 @@ from .common import (
     SoftArgMaxFlowRegression,
     SoftArgMaxFlowRegressionWithDap,
     sample_window,
-    stack_pair,
 )
 
 __all__ = ["CorrelationModule", "SoftArgMaxFlowRegression",
@@ -40,13 +39,18 @@ class CorrelationModule(nn.Module):
         b, h, w, _ = f1.shape
 
         window = sample_window(f2, coords, self.radius)
-        mvol = stack_pair(f1, window)  # (B, du, dv, H, W, 2C)
+        # unstacked pair: MatchingNet's first conv computes the f1 half
+        # once and broadcasts it over the (2r+1)² displacements — the
+        # (B, du, dv, H, W, 2C) stacked volume's f1 copies never exist
+        # (channel order f1-first matches ``stack_pair``, so parameters
+        # and checkpoints are unchanged)
         if self.dtype is not None:
-            mvol = mvol.astype(self.dtype)
+            f1 = f1.astype(self.dtype)
+            window = window.astype(self.dtype)
 
         cost = MatchingNet(norm_type=self.norm_type, scale=self.mnet_scale,
                            dtype=self.dtype)(
-            mvol, train, frozen_bn
+            (f1, window), train, frozen_bn
         )  # (B, H, W, du, dv) float32
 
         if dap:

@@ -15,23 +15,12 @@ from .util import identity_1x1_init
 
 
 def upsample2d_bilinear(x, size):
-    """align_corners=True bilinear resize to ``size`` = (H, W), NHWC."""
-    b, h, w, c = x.shape
-    nh, nw = size
+    """align_corners=True bilinear resize to ``size`` = (H, W), NHWC
+    (static-matrix contraction form — no gather, see
+    ops.upsample.interpolate_bilinear)."""
+    from ...ops.upsample import interpolate_bilinear
 
-    ys = jnp.linspace(0.0, h - 1.0, nh)
-    xs = jnp.linspace(0.0, w - 1.0, nw)
-
-    y0 = jnp.floor(ys).astype(jnp.int32)
-    x0 = jnp.floor(xs).astype(jnp.int32)
-    y1 = jnp.minimum(y0 + 1, h - 1)
-    x1 = jnp.minimum(x0 + 1, w - 1)
-    wy = (ys - y0)[None, :, None, None]
-    wx = (xs - x0)[None, None, :, None]
-
-    top = x[:, y0][:, :, x0] * (1 - wx) + x[:, y0][:, :, x1] * wx
-    bot = x[:, y1][:, :, x0] * (1 - wx) + x[:, y1][:, :, x1] * wx
-    return top * (1 - wy) + bot * wy
+    return interpolate_bilinear(x, size)
 
 
 class HUpNone(nn.Module):

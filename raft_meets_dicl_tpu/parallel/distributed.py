@@ -27,19 +27,27 @@ def initialize(coordinator=None, num_processes=None, process_id=None,
     """
     import jax
 
+    # explicit already-initialized check — matching initialize()'s error
+    # message text is brittle across jax versions and could mask real
+    # failures. The state singleton is private API, so its import is
+    # guarded: if it moves, we just lose the fast-path skip.
+    already = False
     try:
+        from jax._src.distributed import global_state
+
+        already = global_state.client is not None
+    except ImportError:
+        pass
+
+    if already:
+        logging.warning("jax.distributed already initialized; skipping")
+    else:
         jax.distributed.initialize(
             coordinator_address=coordinator,
             num_processes=num_processes,
             process_id=process_id,
             local_device_ids=local_device_ids,
         )
-    except RuntimeError as e:
-        # jax raises 'should only be called once' on re-initialization
-        if "once" in str(e) or "already initialized" in str(e):
-            logging.warning(f"jax.distributed already initialized: {e}")
-        else:
-            raise
 
     import jax as _jax  # backend comes up on first query
 

@@ -210,6 +210,13 @@ class TrainingContext:
         # global batch is assembled in parallel.shard_batch
         n_proc = jax.process_count()
         batch_size = stage.data.batch_size
+        if self.mesh is not None and batch_size % self.mesh.devices.size:
+            # fail with a config-level message before the sharded step
+            # rejects the global array with a partitioner traceback
+            raise ValueError(
+                f"global batch size {batch_size} must be a multiple of the "
+                f"data-mesh device count ({self.mesh.devices.size})"
+            )
         if n_proc > 1:
             if batch_size % n_proc:
                 raise ValueError(
@@ -397,6 +404,17 @@ class TrainingContext:
             raise RuntimeError("non-finite flow values detected")
 
         loss = aux["loss"]
+
+        # multi-process: aux["final"] is the GLOBAL batch array, but
+        # host-side metrics compare against this process's local targets —
+        # reassemble the local slice from the addressable shards (ordered
+        # by their global offset; each process owns one contiguous stripe)
+        if self.mesh is not None and jax.process_count() > 1:
+            shards = sorted(aux["final"].addressable_shards,
+                            key=lambda s: s.index[0].start or 0)
+            aux = aux | {"final": np.concatenate(
+                [np.asarray(s.data) for s in shards])}
+
         result = _StepResult(aux)
 
         self.inspector.on_batch(log, self, stage, epoch, i, img1, img2, flow,

@@ -136,3 +136,162 @@ def test_two_process_data_parallel_train_step(tmp_path):
         assert r["step"] == 1
     # the all-reduced loss must agree across processes
     assert results[0]["loss"] == pytest.approx(results[1]["loss"], rel=1e-6)
+
+
+@pytest.fixture(scope="module")
+def cli_workspace(tmp_path_factory):
+    """Synthetic dataset + tiny configs for driving main.py train."""
+    import cv2
+    import numpy as np
+
+    from raft_meets_dicl_tpu.data import io
+
+    root = tmp_path_factory.mktemp("distcli")
+    scene = root / "data/training/clean/alley_1"
+    flows = root / "data/training/flow/alley_1"
+    scene.mkdir(parents=True)
+    flows.mkdir(parents=True)
+
+    rs = np.random.RandomState(0)
+    for i in range(1, 10):
+        cv2.imwrite(str(scene / f"frame_{i:04d}.png"),
+                    (rs.rand(64, 96, 3) * 255).astype(np.uint8))
+    for i in range(1, 9):
+        io.write_flow_mb(str(flows / f"frame_{i:04d}.flo"),
+                         rs.randn(64, 96, 2).astype(np.float32))
+
+    (root / "dsspec.yaml").write_text("""
+name: Fake Sintel
+id: fake-sintel
+path: ./data
+layout:
+  type: generic
+  images: 'training/{pass}/{scene}/frame_{idx:04d}.png'
+  flows: 'training/flow/{scene}/frame_{idx:04d}.flo'
+  key: '{scene}/frame_{idx:04d}'
+parameters:
+  pass:
+    values: [clean]
+    sub: pass
+""")
+    (root / "data.yaml").write_text("type: dataset\nspec: ./dsspec.yaml\n")
+    (root / "model.yaml").write_text("""
+name: tiny-raft
+id: tiny-raft
+model:
+  type: raft/baseline
+  parameters: {corr-levels: 2, corr-radius: 2, corr-channels: 16,
+               context-channels: 16, recurrent-channels: 16}
+loss:
+  type: raft/sequence
+input:
+  clip: [0, 1]
+""")
+    (root / "strategy.yaml").write_text("""
+name: tiny-strategy
+id: tiny-strategy
+mode: continuous
+stages:
+  - name: s1
+    id: s1
+    data:
+      epochs: 1
+      batch-size: 8
+      source: ./data.yaml
+    validation:
+      source: ./data.yaml
+      batch-size: 1
+    optimizer:
+      type: adam-w
+      parameters: {weight_decay: 1.0e-5}
+    model:
+      arguments: {iterations: 2}
+    lr-scheduler:
+      instance:
+        - type: one-cycle
+          parameters: {max_lr: 1.0e-4, total_steps: '{n_epochs} * {n_batches}', pct_start: 0.3}
+    gradient:
+      clip: {type: norm, value: 1.0}
+""")
+    (root / "inspect.yaml").write_text("""
+metrics:
+  - prefix: 'Train:S{n_stage}:{id_stage}/'
+    frequency: 1
+    metrics:
+      - type: epe
+      - type: loss
+
+checkpoints:
+  path: checkpoints/
+  name: '{id_model}-s{n_stage}_e{n_epoch}_b{n_steps}-epe{m_EndPointError_mean:.4f}.ckpt'
+  compare: ['{m_EndPointError_mean}']
+  keep:
+    latest: 2
+    best: 2
+
+validation:
+  - type: strategy
+    frequency: epoch
+    checkpoint: true
+    tb-metrics-prefix: 'Validation:S{n_stage}:{id_stage}:{id_val}/'
+    metrics:
+      - reduce: mean
+        metric:
+          type: epe
+""")
+    return root
+
+
+def test_cli_distributed_two_processes(cli_workspace, tmp_path):
+    """`main.py train --distributed` as two real processes: the primary
+    owns the run directory (main.log, config.json), secondaries publish
+    nothing, and the run completes on both (SURVEY §5.8; the
+    scripts/cluster/train.sh launch contract, demonstrated at the CLI
+    boundary)."""
+    out_dir = tmp_path / "runs"
+    coordinator = f"localhost:{_free_port()}"
+
+    procs = []
+    for pid in range(2):
+        env = {
+            "PATH": "/usr/bin:/bin",
+            "HOME": "/root",
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        }
+        procs.append(subprocess.Popen(
+            [sys.executable, str(REPO / "main.py"), "train",
+             "-d", str(cli_workspace / "strategy.yaml"),
+             "-m", str(cli_workspace / "model.yaml"),
+             "-i", str(cli_workspace / "inspect.yaml"),
+             "-o", str(out_dir / f"proc{pid}"),
+             "--distributed",
+             "--dist-coordinator", coordinator,
+             "--dist-num-processes", "2",
+             "--dist-process-id", str(pid)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, cwd=str(REPO),
+        ))
+
+    for pid, p in enumerate(procs):
+        stdout, stderr = p.communicate(timeout=900)
+        assert p.returncode == 0, (
+            f"process {pid} failed:\n{stdout[-2000:]}\n{stderr[-2000:]}"
+        )
+
+    # the primary published a run dir with logs and config
+    primary_runs = list((out_dir / "proc0").iterdir())
+    assert len(primary_runs) == 1
+    assert (primary_runs[0] / "main.log").exists()
+    assert (primary_runs[0] / "config.json").exists()
+    assert "training loop complete" in (primary_runs[0] / "main.log").read_text()
+
+    # epoch validation ran on the primary and produced a metric-named
+    # checkpoint there
+    ckpts = list((primary_runs[0] / "checkpoints").glob("*.ckpt"))
+    assert ckpts, "primary produced no validation checkpoint"
+    assert "-epe" in ckpts[0].name
+
+    # the secondary published nothing (scratch dirs are tempdirs, removed
+    # at process exit)
+    assert not (out_dir / "proc1").exists()

@@ -249,3 +249,191 @@ def test_dicl_baseline_forward_parity():
     # level 2 — numerical accumulation, not structure (any structural
     # mismatch shows up as O(1) at the level it happens)
     _assert_flow_lists_match(t_out, f_out, 2e-2, "dicl flow")
+
+
+def _torch_grads_as_tree(tmod, convert_fn):
+    """Run the model's gradients through the same weight-conversion rules
+    as the checkpoint import: the converter's reshapes/transposes are
+    linear, so the converted gradient dict is directly comparable
+    leaf-by-leaf with the flax gradient tree. Buffers (BN running stats)
+    carry no gradient and enter as zeros."""
+    # remove_duplicate=False: the reference registers some norms both as
+    # attributes and inside downsample Sequentials — state_dict lists both
+    # names, named_parameters() would dedupe and lose one alias
+    params = dict(tmod.named_parameters(remove_duplicate=False))
+    state = {}
+    for k, v in tmod.state_dict().items():
+        g = params[k].grad if k in params else None
+        state[k] = g.detach().clone() if g is not None else torch.zeros_like(v)
+    return convert_fn(state, {}).state.model["params"]
+
+
+def _flat_norms(tree, prefix=""):
+    """Flatten a nested dict of arrays into {dotted-path: l2-norm}."""
+    out = {}
+    for k, v in tree.items():
+        path = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out |= _flat_norms(v, path + ".")
+        else:
+            out[path] = float(np.linalg.norm(np.asarray(v, np.float64).ravel()))
+    return out
+
+
+def _assert_grad_norms_match(torch_tree, flax_tree, rtol, label):
+    tn = _flat_norms(torch_tree)
+    fn = _flat_norms(flax_tree)
+    assert set(tn) == set(fn), (
+        f"{label}: gradient trees differ: only-torch="
+        f"{sorted(set(tn) - set(fn))[:5]} only-flax={sorted(set(fn) - set(tn))[:5]}"
+    )
+    worst = ("", 0.0)
+    for k in tn:
+        # floor 1e-5: conv biases directly followed by train-mode batch
+        # norm have mathematically-zero gradients that both frameworks
+        # realize as ~1e-8 fp noise — relative comparison is meaningless
+        # there
+        rel = abs(tn[k] - fn[k]) / max(tn[k], fn[k], 1e-5)
+        if rel > worst[1]:
+            worst = (k, rel)
+    assert worst[1] <= rtol, (
+        f"{label}: grad-norm mismatch at '{worst[0]}': rel diff "
+        f"{worst[1]:.2e} > {rtol} (torch {tn[worst[0]]:.6g} vs "
+        f"flax {fn[worst[0]]:.6g})"
+    )
+
+
+def test_raft_baseline_train_step_gradient_parity():
+    """One training step, train-mode batch norm: loss values and
+    per-tensor gradient norms agree torch-vs-flax — through the
+    scan+remat iteration path and the sequence loss."""
+    import jax
+    import jax.numpy as jnp
+
+    import raft_meets_dicl_tpu.models as models
+    from src.models.impls import raft as ref_raft
+
+    torch.manual_seed(17)
+    tmod = ref_raft.RaftModule()
+    _randomize_batchnorm(tmod, 171)
+    tmod.train()
+
+    chkpt = cc.convert_raft(dict(tmod.state_dict()), {})
+
+    spec = models.load({
+        "name": "RAFT baseline", "id": "raft/baseline",
+        "model": {"type": "raft/baseline", "parameters": {}},
+        "loss": {"type": "raft/sequence"},
+        "input": None,
+    })
+
+    shape = (2, 128, 160, 3)
+    img1, img2 = _images(shape, 172)
+    rng = np.random.default_rng(173)
+    target = rng.normal(0.0, 3.0, size=shape[:3] + (2,)).astype(np.float32)
+    valid = np.ones(shape[:3], bool)
+    iters = 6
+
+    variables = _restore(spec, chkpt, shape, iterations=1)
+
+    # --- torch step
+    t1, t2 = _nchw(img1), _nchw(img2)
+    t_out = tmod(t1, t2, iterations=iters)
+    t_target = _nchw(target)
+    ref_loss_mod = ref_raft.SequenceLoss()
+    t_loss = ref_loss_mod.compute(tmod, t_out, t_target,
+                                  torch.from_numpy(valid))
+    t_loss.backward()
+
+    # --- flax step (train-mode BN, scan + remat backward)
+    def loss_fn(params):
+        out, _new_bs = spec.model.apply(
+            {"params": params, "batch_stats": variables["batch_stats"]},
+            jnp.asarray(img1), jnp.asarray(img2), train=True,
+            iterations=iters, rngs={"dropout": jax.random.PRNGKey(0)},
+        )
+        return spec.loss(spec.model, out, jnp.asarray(target),
+                         jnp.asarray(valid))
+
+    f_loss, f_grads = jax.value_and_grad(loss_fn)(variables["params"])
+
+    rel = abs(float(t_loss) - float(f_loss)) / max(abs(float(t_loss)), 1e-8)
+    assert rel <= 1e-4, (
+        f"loss mismatch: torch {float(t_loss):.6f} vs flax "
+        f"{float(f_loss):.6f} (rel {rel:.2e})"
+    )
+
+    t_grads = _torch_grads_as_tree(tmod, cc.convert_raft)
+    # 1% on per-tensor l2 norms: f32 accumulation over 6 iterations of
+    # backward (measured headroom ~5x)
+    _assert_grad_norms_match(t_grads, f_grads, 1e-2, "raft grads")
+
+
+def test_raft_dicl_ctf_l3_train_step_gradient_parity():
+    """Flagship training step: train-mode BN through the MatchingNets,
+    the restricted multi-level sequence loss over (prev, flow) pairs, and
+    the per-level scan+remat backward."""
+    import jax
+    import jax.numpy as jnp
+
+    import raft_meets_dicl_tpu.models as models
+    from src.models.impls import raft_dicl_ctf_l3 as ref_ctf
+
+    torch.manual_seed(18)
+    tmod = ref_ctf.RaftPlusDiclModule()
+    _randomize_batchnorm(tmod, 181)
+    tmod.train()
+
+    chkpt = cc.convert_raft_dicl(dict(tmod.state_dict()), {})
+
+    loss_args = {"ord": 1, "gamma": 0.85, "alpha": (0.38, 0.6, 1.0),
+                 "delta_range": (128, 64, 32), "delta_mode": "bilinear"}
+    spec = models.load({
+        "name": "RAFT+DICL ctf-l3", "id": "raft+dicl/ctf-l3",
+        "model": {"type": "raft+dicl/ctf-l3", "parameters": {}},
+        "loss": {"type": "raft+dicl/mlseq-restricted",
+                 "arguments": dict(loss_args, alpha=list(loss_args["alpha"]),
+                                   delta_range=list(loss_args["delta_range"]))},
+        "input": None,
+    })
+
+    shape = (1, 128, 192, 3)
+    img1, img2 = _images(shape, 182)
+    rng = np.random.default_rng(183)
+    target = rng.normal(0.0, 3.0, size=shape[:3] + (2,)).astype(np.float32)
+    valid = np.ones(shape[:3], bool)
+    iters = (2, 2, 2)
+
+    variables = _restore(spec, chkpt, shape, iterations=(1, 1, 1))
+
+    # --- torch step
+    t_out = tmod(_nchw(img1), _nchw(img2), iterations=iters, prev_flow=True)
+    ref_loss_mod = ref_ctf.RestrictedMultiLevelSequenceLoss()
+    t_loss = ref_loss_mod.compute(tmod, t_out, _nchw(target),
+                                  torch.from_numpy(valid), **loss_args)
+    t_loss.backward()
+
+    # --- flax step
+    def loss_fn(params):
+        out, _new_bs = spec.model.apply(
+            {"params": params, "batch_stats": variables["batch_stats"]},
+            jnp.asarray(img1), jnp.asarray(img2), train=True,
+            iterations=iters, prev_flow=True,
+            rngs={"dropout": jax.random.PRNGKey(0)},
+        )
+        result = spec.model.get_adapter().wrap_result(out, shape[1:3])
+        return spec.loss(spec.model, result.output(), jnp.asarray(target),
+                         jnp.asarray(valid), **loss_args)
+
+    f_loss, f_grads = jax.value_and_grad(loss_fn)(variables["params"])
+
+    rel = abs(float(t_loss) - float(f_loss)) / max(abs(float(t_loss)), 1e-8)
+    assert rel <= 1e-4, (
+        f"loss mismatch: torch {float(t_loss):.6f} vs flax "
+        f"{float(f_loss):.6f} (rel {rel:.2e})"
+    )
+
+    t_grads = _torch_grads_as_tree(tmod, cc.convert_raft_dicl)
+    # 2%: the ctf backward stacks MatchingNet/BN trains across three
+    # levels; coarse-level grads are small and accumulate relative error
+    _assert_grad_norms_match(t_grads, f_grads, 2e-2, "ctf-l3 grads")
