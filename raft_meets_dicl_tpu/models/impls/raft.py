@@ -63,7 +63,10 @@ class SoftArgMaxFlowRegression(nn.Module):
         out = []
         for lvl in range(self.num_levels):
             if is_levels:
-                score = corr[lvl].reshape(b, h, w, k * k)
+                # per-level windows are (dy, dx)-ordered; flat channels
+                # (and window_delta) are dx-major
+                score = corr[lvl].transpose(0, 1, 2, 4, 3)
+                score = score.reshape(b, h, w, k * k)
             else:
                 score = corr[..., lvl * k * k : (lvl + 1) * k * k]
 
@@ -130,12 +133,15 @@ class _WindowConv1x1(nn.Module):
             y = 0.0
             offset = 0
             for lvl in levels:
-                ka, kk = lvl.shape[-2], lvl.shape[-1]
-                kl = k2[offset : offset + ka * kk].reshape(ka, kk,
-                                                           self.features)
-                y = y + jnp.einsum("bhwak,akf->bhwf", lvl.astype(dt), kl,
+                # level windows are (dy, dx)-ordered; the kernel slice is
+                # dx-major (the flat-tensor channel contract), so reshape
+                # it (dx, dy, f) and contract both axes crosswise
+                kdy, kdx = lvl.shape[-2], lvl.shape[-1]
+                kl = k2[offset : offset + kdy * kdx].reshape(kdx, kdy,
+                                                             self.features)
+                y = y + jnp.einsum("bhwka,akf->bhwf", lvl.astype(dt), kl,
                                    preferred_element_type=jnp.float32)
-                offset += ka * kk
+                offset += kdy * kdx
         return y.astype(dt) + bias.astype(dt)
 
 

@@ -133,7 +133,8 @@ def _lookup_level(corr, x, y):
 
     x, y: (B, H1, W1, K) pixel coordinates into the W2/H2 axes (the K×K
     window factorizes into per-axis offsets). Returns (B, H1, W1, K, K)
-    with axes ordered (x-window, y-window).
+    with axes ordered (y-window, x-window) — dy-major, see the layout
+    note on the final einsum.
 
     TPU-first design: instead of gathering scalars (XLA gather costs ~16ns
     per index on TPU — profiled as 95% of the forward pass), the bilinear
@@ -156,12 +157,15 @@ def _lookup_level(corr, x, y):
                    preferred_element_type=jnp.float32)
     if corr.dtype == jnp.bfloat16:
         t = t.astype(jnp.bfloat16)
-    return jnp.einsum("bijaw,bijkw->bijak", wx, t,
+    # (dy, dx)-ordered output: both einsums then produce k-major layouts,
+    # which XLA keeps without relayout copies between them (the (dx, dy)
+    # order forced a transposed copy of every level in fwd and bwd)
+    return jnp.einsum("bijkw,bijaw->bijka", t, wx,
                       preferred_element_type=jnp.float32)
 
 
 def lookup_pyramid_levels(pyramid, coords, radius, mask_costs=()):
-    """Windowed lookup, one (B, H, W, K_dx, K_dy) tensor per pyramid level.
+    """Windowed lookup, one (B, H, W, K_dy, K_dx) tensor per pyramid level.
 
     The un-flattened variant of ``lookup_pyramid``: consumers that contract
     the window axes anyway (the motion encoder's 1x1 conv, the soft-argmax
@@ -177,7 +181,7 @@ def lookup_pyramid_levels(pyramid, coords, radius, mask_costs=()):
         centers = coords / (2**i)
         x = centers[..., 0:1] + d  # (B, H, W, K) window positions along W2
         y = centers[..., 1:2] + d  # (B, H, W, K) window positions along H2
-        level = _lookup_level(corr, x, y)  # (..., K_dx, K_dy)
+        level = _lookup_level(corr, x, y)  # (..., K_dy, K_dx)
         if i + 3 in mask_costs:
             level = jnp.zeros_like(level)
         out.append(level)
@@ -195,8 +199,10 @@ def lookup_pyramid(pyramid, coords, radius, mask_costs=()):
     """
     k = 2 * radius + 1
     levels = lookup_pyramid_levels(pyramid, coords, radius, mask_costs)
+    # levels are (dy, dx)-ordered; the flat channel contract is dx-major
     return jnp.concatenate(
-        [lvl.reshape(*coords.shape[:3], k * k) for lvl in levels], axis=-1)
+        [lvl.transpose(0, 1, 2, 4, 3).reshape(*coords.shape[:3], k * k)
+         for lvl in levels], axis=-1)
 
 
 class CorrVolume:

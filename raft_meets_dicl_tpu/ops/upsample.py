@@ -8,8 +8,6 @@ align_corners=True)`` inter-level upsampling. NHWC layout.
 import jax
 import jax.numpy as jnp
 
-from .sample import sample_bilinear
-
 
 def _neighbors3x3(x):
     """Stack the 3x3 neighborhood of each pixel: (B,H,W,C) -> (B,H,W,9,C).

@@ -42,12 +42,20 @@ def initialize(coordinator=None, num_processes=None, process_id=None,
     if already:
         logging.warning("jax.distributed already initialized; skipping")
     else:
-        jax.distributed.initialize(
-            coordinator_address=coordinator,
-            num_processes=num_processes,
-            process_id=process_id,
-            local_device_ids=local_device_ids,
-        )
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=num_processes,
+                process_id=process_id,
+                local_device_ids=local_device_ids,
+            )
+        except RuntimeError as e:
+            # belt-and-braces for the case the private check above could
+            # not run: jax raises 'should only be called once' on re-init
+            if "once" in str(e) or "already initialized" in str(e):
+                logging.warning(f"jax.distributed already initialized: {e}")
+            else:
+                raise
 
     import jax as _jax  # backend comes up on first query
 

@@ -33,9 +33,9 @@ def capture(trace_dir):
     width = int(os.environ.get("BENCH_WIDTH", "720"))
     iters = int(os.environ.get("BENCH_ITERS", "12"))
     model_ty = os.environ.get("BENCH_MODEL", "raft/baseline")
-    # profile what bench.py measures: bf16 policy on both bench models
+    # profile what bench.py measures: bf16 policy on the bench models
     model_params = {"mixed-precision": True} \
-        if model_ty in ("raft/baseline",) or \
+        if model_ty in ("raft/baseline", "raft/fs") or \
         model_ty.startswith("raft+dicl/ctf") else {}
     if model_ty.startswith("raft+dicl/ctf"):
         levels = int(model_ty[-1])
