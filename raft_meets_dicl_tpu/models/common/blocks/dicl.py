@@ -21,20 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from ..norm import Norm2d
-from ..util import identity_1x1_init
-
-
-class _ConvKernel(nn.Module):
-    """Holds an ``nn.Conv``-compatible (bias-free) kernel without applying
-    it, so one parameter set can be applied as split partial convolutions."""
-
-    features: int
-    kernel_size: tuple
-
-    @nn.compact
-    def __call__(self, in_features):
-        return self.param("kernel", nn.initializers.lecun_normal(),
-                          (*self.kernel_size, in_features, self.features))
+from ..util import ConvParams, identity_1x1_init
 
 
 class ConvBlock(nn.Module):
@@ -61,9 +48,9 @@ class ConvBlock(nn.Module):
         if isinstance(x, tuple):
             shared, per_item = x
             c1 = shared.shape[-1]
-            kernel = _ConvKernel(
+            kernel = ConvParams(
                 self.c_out, (self.kernel_size, self.kernel_size),
-                name="Conv_0")(c1 + per_item.shape[-1])
+                use_bias=False, name="Conv_0")(c1 + per_item.shape[-1])
 
             dt = self.dtype or kernel.dtype
             pad = self.dilation * (self.kernel_size // 2)

@@ -12,7 +12,6 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from ....ops.corr import window_delta
-from ....ops.sample import sample_bilinear
 from ..blocks.dicl import DisplacementAwareProjection
 
 

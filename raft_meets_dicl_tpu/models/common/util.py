@@ -1,5 +1,6 @@
 """Small shared helpers for the NN layer."""
 
+import flax.linen as nn
 import jax.numpy as jnp
 
 
@@ -15,3 +16,25 @@ def unfold3x3(x):
 def identity_1x1_init(key, shape, dtype=jnp.float32):
     """(1, 1, C, C) identity kernel — identity-initialized 1x1 convs."""
     return jnp.eye(shape[-1], dtype=dtype).reshape(shape)
+
+
+class ConvParams(nn.Module):
+    """Holds an ``nn.Conv``-compatible kernel (+ optional bias) without
+    applying them: parameter names, shapes, and initializers match what
+    ``nn.Conv`` would create, so checkpoint trees stay identical when
+    sibling convolutions are merged into one call or one conv is applied
+    as split partial convolutions (linearity)."""
+
+    features: int
+    kernel_size: tuple
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, in_features):
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (*self.kernel_size, in_features, self.features))
+        if not self.use_bias:
+            return kernel
+        bias = self.param("bias", nn.initializers.zeros_init(),
+                          (self.features,))
+        return kernel, bias

@@ -32,6 +32,7 @@ from ...ops.corr import (
 from ...ops.upsample import convex_upsample_8x
 from .. import common
 from ..common.blocks.dicl import DisplacementAwareProjection
+from ..common.util import ConvParams
 from ..common.grid import coordinate_grid
 from ..common.hsup import upsample2d_bilinear
 from ..config import register_loss, register_model
@@ -173,26 +174,6 @@ class BasicMotionEncoder(nn.Module):
         return jnp.concatenate((combined, flow), axis=-1)  # 128 channels
 
 
-class _ConvParams(nn.Module):
-    """Holds an ``nn.Conv``-compatible kernel + bias without applying them.
-
-    Lets sibling convolutions with a shared input be merged into one conv
-    call (concatenated output channels) while the checkpoint tree keeps the
-    reference's one-param-set-per-conv structure.
-    """
-
-    features: int
-    kernel_size: Tuple[int, int]
-
-    @nn.compact
-    def __call__(self, in_features):
-        kernel = self.param("kernel", nn.initializers.lecun_normal(),
-                            (*self.kernel_size, in_features, self.features))
-        bias = self.param("bias", nn.initializers.zeros_init(),
-                          (self.features,))
-        return kernel, bias
-
-
 class SepConvGru(nn.Module):
     """Separable (1x5 then 5x1) convolutional GRU.
 
@@ -220,11 +201,11 @@ class SepConvGru(nn.Module):
         dt = self.dtype
         hd = self.hidden_dim
         for i, ksize in enumerate(((1, 5), (5, 1))):
-            zk, zb = _ConvParams(hd, ksize, name=f"Conv_{3 * i}")(
+            zk, zb = ConvParams(hd, ksize, name=f"Conv_{3 * i}")(
                 h.shape[-1] + x.shape[-1])
-            rk, rb = _ConvParams(hd, ksize, name=f"Conv_{3 * i + 1}")(
+            rk, rb = ConvParams(hd, ksize, name=f"Conv_{3 * i + 1}")(
                 h.shape[-1] + x.shape[-1])
-            qk, qb = _ConvParams(hd, ksize, name=f"Conv_{3 * i + 2}")(
+            qk, qb = ConvParams(hd, ksize, name=f"Conv_{3 * i + 2}")(
                 h.shape[-1] + x.shape[-1])
 
             cdt = dt or zk.dtype

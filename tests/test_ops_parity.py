@@ -261,3 +261,38 @@ def test_windowed_corr_pyramid_kernel_matches_reference():
     assert np.allclose(np.asarray(df1), np.asarray(df1_r), atol=1e-4)
     for got, want in zip(df2, df2_r):
         assert np.allclose(np.asarray(got), np.asarray(want), atol=1e-4)
+
+
+def test_sample_window_matches_grid_sample_definition():
+    """sample_window (patch decomposition + separable lerps) equals the
+    per-displacement grid_sample definition on raw (unclamped) centers,
+    values and f2 gradients."""
+    import jax
+    import jax.numpy as jnp
+
+    from raft_meets_dicl_tpu.models.common.corr.common import sample_window
+    from raft_meets_dicl_tpu.ops.corr import window_delta
+    from raft_meets_dicl_tpu.ops.sample import sample_bilinear
+
+    def sample_window_gs(f2, coords, radius):
+        b, h, w = coords.shape[:3]
+        c = f2.shape[-1]
+        k = 2 * radius + 1
+        delta = window_delta(radius, coords.dtype)
+        pos = coords[:, None, None] + delta[None, :, :, None, None]
+        s = sample_bilinear(f2, pos[..., 0].reshape(b, -1),
+                            pos[..., 1].reshape(b, -1))
+        return s.reshape(b, k, k, h, w, c)
+
+    rng = np.random.RandomState(4)
+    f2 = jnp.asarray(rng.randn(2, 13, 17, 5), jnp.float32)
+    raw = jnp.asarray(rng.randn(2, 6, 7, 2) * 12.0, jnp.float32)
+
+    a = sample_window_gs(f2, raw, 3)
+    b_ = sample_window(f2, raw, 3)
+    np.testing.assert_allclose(np.asarray(b_), np.asarray(a), atol=1e-5)
+
+    g = jnp.asarray(rng.randn(*a.shape), jnp.float32)
+    da = jax.grad(lambda m: (sample_window_gs(m, raw, 3) * g).sum())(f2)
+    db = jax.grad(lambda m: (sample_window(m, raw, 3) * g).sum())(f2)
+    np.testing.assert_allclose(np.asarray(db), np.asarray(da), atol=1e-5)
