@@ -74,6 +74,12 @@ def _measure(model_cfg, loss_cfg, batch, height, width, model_args, steps):
 
 
 def main():
+    # persistent compile cache: cold zoo compiles total ~40 min and have
+    # overrun the harness budget (BENCH_r04 rc=124); with a warmed cache
+    # the full run is measurement-dominated (~5 min)
+    from raft_meets_dicl_tpu.utils.compcache import enable_persistent_cache
+    enable_persistent_cache()
+
     batch = int(os.environ.get("BENCH_BATCH", "6"))
     height = int(os.environ.get("BENCH_HEIGHT", "400"))
     width = int(os.environ.get("BENCH_WIDTH", "720"))
@@ -149,14 +155,12 @@ def main():
               "arguments": {"gamma": 0.85, "alpha": [0.38, 0.6, 1.0]}},
              (1, 64, 128, {"iterations": (2, 1, 1)}, 2) if cpu else
              (6, 384, 704, {"iterations": (4, 3, 3)}, 3)),
-            # raft+dicl/ml: multi-level DICL lookup, single RAFT loop.
-            # Reduced shape: the full Things config (b6, 384x704, 12 iters)
-            # crashes the TPU compiler service on this model's multi-level
-            # graph — b2/256x448/6 is the largest verified-compiling config
+            # raft+dicl/ml: multi-level DICL lookup, single RAFT loop,
+            # at the reference Things shape (b6, 384x704, 12 iters)
             ("raft_dicl_ml", {"type": "raft+dicl/ml", "parameters": {}},
              {"type": "raft/sequence"},
              (1, 64, 128, {"iterations": 2}, 2) if cpu else
-             (2, 256, 448, {"iterations": 6}, 3)),
+             (6, 384, 704, {"iterations": 12}, 3)),
             # dicl/baseline: pure DICL coarse-to-fine (GA-Net encoder)
             ("dicl_baseline",
              {"type": "dicl/baseline",
@@ -168,13 +172,30 @@ def main():
                             "ord": 2}},
              (1, 128, 128, {}, 2) if cpu else (6, 384, 768, {}, 3)),
         ]
-        for name, model_cfg, loss_cfg, (zb, zh, zw, zargs, zsteps) in zoo:
-            try:
-                pairs, _ = _measure(model_cfg, loss_cfg, zb, zh, zw,
-                                    zargs, zsteps)
-                result[f"{name}_pairs_per_sec"] = round(pairs, 3)
-            except Exception as e:  # noqa: BLE001
-                result[f"{name}_error"] = f"{type(e).__name__}: {str(e)[:120]}"
+        # labeled fallback shapes: if a model fails at its reference shape
+        # (e.g. a compiler-service crash) the bench still reports a number,
+        # and the JSON says explicitly which config produced it (so reduced
+        # measurements are never silently comparable to full ones)
+        fallbacks = {
+            "raft_dicl_ml": [((2, 256, 448, {"iterations": 6}, 3),
+                              "reduced:b2/256x448/6-iters")],
+        }
+        for name, model_cfg, loss_cfg, shape in zoo:
+            candidates = [(shape, None)]
+            if not cpu:
+                candidates += fallbacks.get(name, [])
+            for (zb, zh, zw, zargs, zsteps), label in candidates:
+                try:
+                    pairs, _ = _measure(model_cfg, loss_cfg, zb, zh, zw,
+                                        zargs, zsteps)
+                    result[f"{name}_pairs_per_sec"] = round(pairs, 3)
+                    if label:
+                        result[f"{name}_config"] = label
+                    result.pop(f"{name}_error", None)
+                    break
+                except Exception as e:  # noqa: BLE001
+                    result[f"{name}_error"] = (
+                        f"{type(e).__name__}: {str(e)[:120]}")
             print(json.dumps(result), flush=True)
 
 

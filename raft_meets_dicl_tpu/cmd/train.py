@@ -90,7 +90,20 @@ def select_devices(device=None, device_ids=None):
         except RuntimeError:
             pass  # backend already initialized; fall through to filtering
 
-    devices = jax.devices(device) if device else jax.devices()
+    if device:
+        try:
+            devices = jax.devices(device)
+        except RuntimeError as e:
+            # surface an unknown/unavailable platform as a config-level
+            # message: the config update above is a global side effect,
+            # and backend init otherwise fails later with a confusing
+            # error
+            raise ValueError(
+                f"--device '{device}': no such jax platform available "
+                f"({e})"
+            ) from e
+    else:
+        devices = jax.devices()
 
     if device_ids:
         ids = [int(i.strip()) for i in device_ids.split(",")]

@@ -26,6 +26,9 @@ from . import cmd
 
 
 def main():
+    from .utils.compcache import enable_persistent_cache
+    enable_persistent_cache()
+
     def fmtcls(prog):
         return argparse.HelpFormatter(prog, max_help_position=42)
 

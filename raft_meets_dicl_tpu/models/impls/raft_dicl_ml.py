@@ -281,9 +281,9 @@ class RaftPlusDiclMlModule(nn.Module):
         upnet8 = nn.remat(Up8Network, prevent_cse=False)(
             dtype=dt, name="Up8Network_0")
 
-        # one (remat-wrapped) step body serves both realizations; scan
-        # unless batch norm is actually training (the lifted scan
-        # broadcasts batch_stats read-only; see raft_dicl_ctf)
+        # one (remat-wrapped) step body serves both realizations: the
+        # lax.scan (default) or a python-unrolled loop (`unroll=True`,
+        # kept as a debugging escape hatch)
         if self.remat:
             body = nn.remat(
                 _MlStep, prevent_cse=False,
@@ -298,7 +298,7 @@ class RaftPlusDiclMlModule(nn.Module):
             train=train, frozen_bn=frozen_bn,
         )
 
-        if self.unroll or (train and not frozen_bn):
+        if self.unroll:
             step = body(**shared)
             carry = (h, coords1)
             flows, hiddens, corr_flows = [], [], []
@@ -317,9 +317,19 @@ class RaftPlusDiclMlModule(nn.Module):
                 for lvl in range(self.corr_levels)
             )
         else:
+            # train-mode batch norm mutates running stats every iteration;
+            # carrying the batch_stats collection through the scan keeps
+            # the sequential-update semantics of the unrolled loop while
+            # compiling ONE step body — the 12x-unrolled train graph of
+            # this model (12 iterations x 4 MatchingNets) is what crashed
+            # the TPU compiler service at the reference Things config
+            # (b6/384x704; see PERF.md round 5)
+            live_bn = train and not frozen_bn
             step = nn.scan(
                 body,
-                variable_broadcast=["params", "batch_stats"],
+                variable_broadcast=(["params"] if live_bn
+                                    else ["params", "batch_stats"]),
+                variable_carry=["batch_stats"] if live_bn else [],
                 split_rngs={"params": False, "dropout": True},
                 in_axes=(0, nn.broadcast, nn.broadcast, nn.broadcast,
                          nn.broadcast),

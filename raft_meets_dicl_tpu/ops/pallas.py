@@ -232,13 +232,23 @@ _combine.defvjp(_combine_fwd, _combine_bwd)
 _XW = 24
 
 
+# Band-sharing chunk parameters: _PB consecutive positions share one
+# (k+9, _XBW, C) slab read + one MXU contraction when their windows
+# overlap enough (the flow-smooth case); otherwise the chunk falls back
+# to the per-position path. _XBW covers the (k+1)-lane window + ≤7-lane
+# alignment residual + ≤8 lanes of x-spread for radius ≤ 7.
+_XBW = 32
+_PB = 8
+
+
 def _wcp_pads(radius):
     """(lo, hi_y, hi_x) zero-padding of the f2 maps so every clamped,
     8-aligned window is a plain in-bounds slice: x-starts lie in
     [0, lo + dim] after clamping centers to [-(r+1), dim+r], and the
-    widened slab extends _XW past the start."""
+    widened slab extends _XW (per-position) / _XBW with k+9 rows
+    (band-shared) past the start."""
     lo = 2 * radius + 1
-    return lo, 2 * radius + 2, _XW
+    return lo, 2 * radius + 10, _XBW
 
 
 def _wcp_window(cx, cy, lvl, dim_h, dim_w, radius):
@@ -299,6 +309,92 @@ def _wcp_fwd_kernel(coords_ref, f1_ref, *f2_refs_and_out, radius, dims):
     jax.lax.fori_loop(0, n_j, body, 0)
 
 
+def _wcp_fwd_band_kernel(coords_ref, f1_ref, *f2_refs_and_out, radius,
+                        dims):
+    """Band-shared forward: chunks of _PB consecutive positions.
+
+    Shared path per chunk·level — the bandwidth fix for the per-position
+    kernel (PERF.md round 4: slab reads were 8x redundant for smooth
+    flow):
+      1. ONE (k+9, _XBW, C) slab read;
+      2. ONE MXU contraction against the chunk's stacked f1 rows
+         ((k+9)·_XBW, C) x (C, _PB);
+      3. bilinear windows resolved with arithmetic selection masks —
+         y as a pair-lerp plus pure row-selection (static dy loop), x as
+         the lerped lane-selection (static dx loop) — no dynamic lane
+         slicing, the constraint that killed the round-4 j-vectorization
+         attempts.
+    The per-position fallback (identical math to _wcp_fwd_kernel) runs
+    whenever the chunk's window spread exceeds the shared slab.
+    """
+    f2_refs = f2_refs_and_out[:-1]
+    out_ref = f2_refs_and_out[-1]
+    k = 2 * radius + 1
+    yb = k + 9
+    n_c = f1_ref.shape[2]
+
+    def chunk(ci, _):
+        f1c = f1_ref[0, 0, ci].astype(jnp.float32)          # (_PB, C)
+
+        for lvl, f2_ref in enumerate(f2_refs):
+            h2, w2 = dims[lvl]
+            xs, ys, fxs, fys, xb8, ymin, fits = _wcp_band_params(
+                coords_ref, ci, lvl, h2, w2, radius)
+
+            def shared(lvl=lvl, f2_ref=f2_ref, xs=xs, ys=ys, fxs=fxs,
+                       fys=fys, xb8=xb8, ymin=ymin):
+                slab = f2_ref[0, pl.ds(ymin, yb), pl.ds(xb8, _XBW), :]
+                s2 = slab.astype(jnp.float32).reshape(yb * _XBW, -1)
+                d = jax.lax.dot_general(
+                    s2, f1c, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)     # (yb*_XBW, _PB)
+                d3 = d.reshape(yb, _XBW, _PB)
+
+                fyv = jnp.stack(fys).reshape(1, 1, _PB)
+                t = (1.0 - fyv) * d3[0:yb - 1] + fyv * d3[1:yb]
+
+                syv = jnp.stack([y - ymin for y in ys]).reshape(1, 1, _PB)
+                iy = jax.lax.broadcasted_iota(jnp.int32, (yb - 1, 1, _PB), 0)
+                e = jnp.stack([
+                    jnp.sum(jnp.where(iy == syv + dy, t, 0.0), axis=0)
+                    for dy in range(k)
+                ])                                          # (k_dy, _XBW, _PB)
+
+                sxv = jnp.stack([x - xb8 for x in xs]).reshape(1, 1, _PB)
+                fxv = jnp.stack(fxs).reshape(1, 1, _PB)
+                ix = jax.lax.broadcasted_iota(jnp.int32, (1, _XBW, _PB), 1)
+                return jnp.stack([
+                    jnp.sum(((ix == sxv + dx) * (1.0 - fxv)
+                             + (ix == sxv + dx + 1) * fxv) * e, axis=1)
+                    for dx in range(k)
+                ])                                          # (k_dx, k_dy, _PB)
+
+            def fallback(lvl=lvl, f2_ref=f2_ref, xs=xs, ys=ys, fxs=fxs,
+                         fys=fys):
+                vs = []
+                for p in range(_PB):
+                    x8p = pl.multiple_of((xs[p] // 8) * 8, 8)
+                    sp = xs[p] - x8p
+                    slab = f2_ref[0, pl.ds(ys[p], k + 1),
+                                  pl.ds(x8p, _XW), :]
+                    dd = jnp.sum(
+                        slab.astype(jnp.float32)
+                        * f1c[p:p + 1, :][None, :, :], axis=-1)
+                    t = (1.0 - fys[p]) * dd[0:k, :] + fys[p] * dd[1:k + 1, :]
+                    m = _x_select(sp, fxs[p], k)
+                    v = jnp.sum(t[:, :, None] * m[None, :, :], axis=1)
+                    vs.append(v.T)                          # (k_dx, k_dy)
+                return jnp.stack(vs, axis=-1)               # (k, k, _PB)
+
+            v = jax.lax.cond(fits, shared, fallback)
+            for p in range(_PB):
+                out_ref[0, 0, ci * _PB + p,
+                        lvl * k:(lvl + 1) * k, :] = v[:, :, p]
+        return 0
+
+    jax.lax.fori_loop(0, n_c, chunk, 0)
+
+
 def _unlerp(dout_ref, j, lvl, s, fx, fy, radius):
     """Transpose of the window lerps: spread the (dy, dx) cost gradient of
     position j at level lvl onto the widened (k+1, _XW) slab."""
@@ -309,6 +405,169 @@ def _unlerp(dout_ref, j, lvl, s, fx, fy, radius):
     zr = jnp.zeros((1, _XW), jnp.float32)
     return ((1.0 - fy) * jnp.concatenate([dt, zr], axis=0)
             + fy * jnp.concatenate([zr, dt], axis=0))     # (k+1, _XW)
+
+
+def _wcp_band_params(coords_ref, ci, lvl, h2, w2, radius):
+    """Per-chunk window parameters + the shared-slab fit predicate."""
+    k = 2 * radius + 1
+    xs, ys, fxs, fys = [], [], [], []
+    for p in range(_PB):
+        cx = coords_ref[0, 0, ci * _PB + p, 0]
+        cy = coords_ref[0, 0, ci * _PB + p, 1]
+        x8, s, y0, fx, fy = _wcp_window(cx, cy, lvl, h2, w2, radius)
+        xs.append(x8 + s)
+        ys.append(y0)
+        fxs.append(fx)
+        fys.append(fy)
+    xmin = functools.reduce(jnp.minimum, xs)
+    xmax = functools.reduce(jnp.maximum, xs)
+    ymin = functools.reduce(jnp.minimum, ys)
+    ymax = functools.reduce(jnp.maximum, ys)
+    xb8 = pl.multiple_of((xmin // 8) * 8, 8)
+    fits = jnp.logical_and(xmax - xb8 <= _XBW - 1 - (k + 1),
+                           ymax - ymin <= 8)
+    return xs, ys, fxs, fys, xb8, ymin, fits
+
+
+def _wcp_band_dv(dout_ref, ci, lvl, radius):
+    """The chunk's (k_dx, k_dy, _PB) output-gradient stack."""
+    k = 2 * radius + 1
+    return jnp.stack([
+        dout_ref[0, 0, ci * _PB + p, lvl * k:(lvl + 1) * k, :]
+        for p in range(_PB)
+    ], axis=-1)
+
+
+def _wcp_band_dD3(dv, xs, ys, fxs, fys, xb8, ymin, radius):
+    """Transpose of the band forward's selection/lerp chain: spread the
+    (k, k, _PB) cost gradients onto the shared (k+9, _XBW) slab grid."""
+    k = 2 * radius + 1
+    yb = k + 9
+
+    sxv = jnp.stack([x - xb8 for x in xs]).reshape(1, 1, _PB)
+    fxv = jnp.stack(fxs).reshape(1, 1, _PB)
+    ix = jax.lax.broadcasted_iota(jnp.int32, (1, _XBW, _PB), 1)
+    de = sum(
+        ((ix == sxv + dx) * (1.0 - fxv) + (ix == sxv + dx + 1) * fxv)
+        * dv[dx][:, None, :]
+        for dx in range(k)
+    )                                               # (k_dy, _XBW, _PB)
+
+    syv = jnp.stack([y - ymin for y in ys]).reshape(1, 1, _PB)
+    iy = jax.lax.broadcasted_iota(jnp.int32, (yb - 1, 1, _PB), 0)
+    dt = sum(
+        jnp.where(iy == syv + dy, de[dy][None, :, :], 0.0)
+        for dy in range(k)
+    )                                               # (yb-1, _XBW, _PB)
+
+    fyv = jnp.stack(fys).reshape(1, 1, _PB)
+    zr = jnp.zeros((1, _XBW, _PB), jnp.float32)
+    return ((1.0 - fyv) * jnp.concatenate([dt, zr], axis=0)
+            + fyv * jnp.concatenate([zr, dt], axis=0))  # (yb, _XBW, _PB)
+
+
+def _wcp_bwd_df1_band_kernel(coords_ref, dout_ref, *f2_refs_and_out,
+                             radius, dims):
+    """Band-shared df1: per chunk·level ONE slab read and ONE MXU
+    contraction dD3^T(yb*_XBW, _PB) x slab(yb*_XBW, C) -> (_PB, C)."""
+    f2_refs = f2_refs_and_out[:-1]
+    df1_ref = f2_refs_and_out[-1]
+    k = 2 * radius + 1
+    yb = k + 9
+    n_c = df1_ref.shape[2]
+
+    def chunk(ci, _):
+        acc = jnp.zeros((_PB, f2_refs[0].shape[-1]), jnp.float32)
+        for lvl, f2_ref in enumerate(f2_refs):
+            h2, w2 = dims[lvl]
+            xs, ys, fxs, fys, xb8, ymin, fits = _wcp_band_params(
+                coords_ref, ci, lvl, h2, w2, radius)
+            dv = _wcp_band_dv(dout_ref, ci, lvl, radius)
+
+            def shared(f2_ref=f2_ref, xs=xs, ys=ys, fxs=fxs, fys=fys,
+                       xb8=xb8, ymin=ymin, dv=dv):
+                dd3 = _wcp_band_dD3(dv, xs, ys, fxs, fys, xb8, ymin,
+                                    radius)
+                slab = f2_ref[0, pl.ds(ymin, yb), pl.ds(xb8, _XBW), :]
+                s2 = slab.astype(jnp.float32).reshape(yb * _XBW, -1)
+                return jax.lax.dot_general(
+                    dd3.reshape(yb * _XBW, _PB), s2,
+                    (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)     # (_PB, C)
+
+            def fallback(f2_ref=f2_ref, xs=xs, ys=ys, fxs=fxs, fys=fys,
+                         dv=dv, lvl=lvl):
+                outs = []
+                for p in range(_PB):
+                    x8p = pl.multiple_of((xs[p] // 8) * 8, 8)
+                    sp = xs[p] - x8p
+                    m = _x_select(sp, fxs[p], k)
+                    dvp = dv[:, :, p].T                     # (k_dy, k_dx)
+                    dt = jnp.sum(dvp[:, None, :] * m[None, :, :], axis=2)
+                    zr = jnp.zeros((1, _XW), jnp.float32)
+                    dd = ((1.0 - fys[p])
+                          * jnp.concatenate([dt, zr], axis=0)
+                          + fys[p] * jnp.concatenate([zr, dt], axis=0))
+                    slab = f2_ref[0, pl.ds(ys[p], k + 1),
+                                  pl.ds(x8p, _XW), :]
+                    part = jnp.sum(dd[:, :, None]
+                                   * slab.astype(jnp.float32), axis=(0, 1))
+                    outs.append(part)
+                return jnp.stack(outs)                      # (_PB, C)
+
+            acc = acc + jax.lax.cond(fits, shared, fallback)
+        df1_ref[0, 0, ci] = acc
+        return 0
+
+    jax.lax.fori_loop(0, n_c, chunk, 0)
+
+
+def _wcp_bwd_df2_band_kernel(coords_ref, f1_ref, dout_ref, df2_ref, *,
+                             radius, lvl, dims):
+    """Band-shared df2 for ONE level: per chunk ONE MXU outer product
+    dD3(yb*_XBW, _PB) x f1c(_PB, C) accumulated into the shared slab."""
+    k = 2 * radius + 1
+    yb = k + 9
+    n_c = f1_ref.shape[2]
+    h2, w2 = dims
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _():
+        df2_ref[:] = jnp.zeros_like(df2_ref)
+
+    def chunk(ci, _):
+        f1c = f1_ref[0, 0, ci].astype(jnp.float32)          # (_PB, C)
+        xs, ys, fxs, fys, xb8, ymin, fits = _wcp_band_params(
+            coords_ref, ci, lvl, h2, w2, radius)
+        dv = _wcp_band_dv(dout_ref, ci, 0, radius)
+
+        def shared():
+            dd3 = _wcp_band_dD3(dv, xs, ys, fxs, fys, xb8, ymin, radius)
+            ds2 = jax.lax.dot_general(
+                dd3.reshape(yb * _XBW, _PB), f1c,
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)         # (yb*_XBW, C)
+            df2_ref[0, pl.ds(ymin, yb), pl.ds(xb8, _XBW), :] += (
+                ds2.reshape(yb, _XBW, -1))
+
+        def fallback():
+            for p in range(_PB):
+                x8p = pl.multiple_of((xs[p] // 8) * 8, 8)
+                sp = xs[p] - x8p
+                m = _x_select(sp, fxs[p], k)
+                dvp = dv[:, :, p].T                         # (k_dy, k_dx)
+                dt = jnp.sum(dvp[:, None, :] * m[None, :, :], axis=2)
+                zr = jnp.zeros((1, _XW), jnp.float32)
+                dd = ((1.0 - fys[p]) * jnp.concatenate([dt, zr], axis=0)
+                      + fys[p] * jnp.concatenate([zr, dt], axis=0))
+                df2_ref[0, pl.ds(ys[p], k + 1), pl.ds(x8p, _XW), :] += (
+                    dd[:, :, None] * f1c[p:p + 1, :][None, :, :])
+
+        jax.lax.cond(fits, shared, fallback)
+        return 0
+
+    jax.lax.fori_loop(0, n_c, chunk, 0)
 
 
 def _wcp_bwd_df1_kernel(coords_ref, dout_ref, *f2_refs_and_out, radius,
@@ -386,68 +645,125 @@ def _wcp_bwd_interpret(f1, f2_levels, coords, dout, radius):
                         interpret=True)
 
 
-def _wcp_fwd_tpu(f1, f2_levels, coords, radius, interpret=False):
+def _wcp_fwd_tpu(f1, f2_levels, coords, radius, interpret=False,
+                 band=None):
     b, n_i, n_j, c = f1.shape
     k = 2 * radius + 1
     n_lvl = len(f2_levels)
     dims = tuple((f2.shape[1], f2.shape[2]) for f2 in f2_levels)
     f2p = _wcp_pad_f2(f2_levels, radius)
+    if band is None:
+        band = _wcp_band_enabled()
 
-    # j rides an untiled axis (the dummy sublane dim keeps the last-two
-    # dims static so per-position dynamic indexing is legal)
-    f1r = f1.reshape(b, n_i, n_j, 1, c)
-
-    kernel = functools.partial(_wcp_fwd_kernel, radius=radius, dims=dims)
+    if band:
+        # pad the position axis to whole chunks; padded positions sample
+        # around coord 0 (in-bounds garbage) and are sliced off below
+        n_jp = -(-n_j // _PB) * _PB
+        if n_jp != n_j:
+            f1 = jnp.pad(f1, ((0, 0), (0, 0), (0, n_jp - n_j), (0, 0)))
+            coords = jnp.pad(coords,
+                             ((0, 0), (0, 0), (0, n_jp - n_j), (0, 0)))
+        f1r = f1.reshape(b, n_i, n_jp // _PB, _PB, c)
+        kernel = functools.partial(_wcp_fwd_band_kernel, radius=radius,
+                                   dims=dims)
+        f1_spec = pl.BlockSpec((1, 1, n_jp // _PB, _PB, c),
+                               lambda bi, ii: (bi, ii, 0, 0, 0),
+                               memory_space=pltpu.VMEM)
+    else:
+        n_jp = n_j
+        # j rides an untiled axis (the dummy sublane dim keeps the
+        # last-two dims static so per-position dynamic indexing is legal)
+        f1r = f1.reshape(b, n_i, n_j, 1, c)
+        kernel = functools.partial(_wcp_fwd_kernel, radius=radius,
+                                   dims=dims)
+        f1_spec = pl.BlockSpec((1, 1, n_j, 1, c),
+                               lambda bi, ii: (bi, ii, 0, 0, 0),
+                               memory_space=pltpu.VMEM)
 
     out = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((b, n_i, n_j, n_lvl * k, k),
+        out_shape=jax.ShapeDtypeStruct((b, n_i, n_jp, n_lvl * k, k),
                                        jnp.float32),
         grid=(b, n_i),
         in_specs=[
-            pl.BlockSpec((1, 1, n_j, 2), lambda bi, ii: (bi, ii, 0, 0),
+            pl.BlockSpec((1, 1, n_jp, 2), lambda bi, ii: (bi, ii, 0, 0),
                          memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1, n_j, 1, c), lambda bi, ii: (bi, ii, 0, 0, 0),
-                         memory_space=pltpu.VMEM),
+            f1_spec,
         ] + [
             pl.BlockSpec((1,) + f2.shape[1:], lambda bi, ii: (bi, 0, 0, 0),
                          memory_space=pltpu.VMEM)
             for f2 in f2p
         ],
-        out_specs=pl.BlockSpec((1, 1, n_j, n_lvl * k, k),
+        out_specs=pl.BlockSpec((1, 1, n_jp, n_lvl * k, k),
                                lambda bi, ii: (bi, ii, 0, 0, 0),
                                memory_space=pltpu.VMEM),
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )(coords, f1r, *f2p)
+    out = out[:, :, :n_j]
     # (level, dx, dy) channel flatten — (L*k, k) row-major is exactly that
     return out.reshape(b, n_i, n_j, n_lvl * k * k)
 
 
-def _wcp_bwd_tpu(f1, f2_levels, coords, dout, radius, interpret=False):
+def _wcp_band_enabled():
+    import os
+
+    return os.environ.get("RMD_WCP_BAND", "1") != "0"
+
+
+def _wcp_bwd_tpu(f1, f2_levels, coords, dout, radius, interpret=False,
+                 band=None):
     b, n_i, n_j, c = f1.shape
     lo, _hi_y, _hi_x = _wcp_pads(radius)
     f2p = _wcp_pad_f2(f2_levels, radius)
     dims = tuple((f2.shape[1], f2.shape[2]) for f2 in f2_levels)
+    if band is None:
+        band = _wcp_band_enabled()
 
     k = 2 * radius + 1
     n_lvl = len(f2_levels)
-    f1r = f1.reshape(b, n_i, n_j, 1, c)
-    doutr = dout.reshape(b, n_i, n_j, n_lvl * k, k)
 
-    coords_spec = pl.BlockSpec((1, 1, n_j, 2), lambda bi, ii: (bi, ii, 0, 0),
+    if band:
+        # whole-chunk padding; padded positions carry zero dout and
+        # coords 0 (in-bounds), so they contribute nothing to df1/df2
+        n_jp = -(-n_j // _PB) * _PB
+        if n_jp != n_j:
+            pad = ((0, 0), (0, 0), (0, n_jp - n_j), (0, 0))
+            f1 = jnp.pad(f1, pad)
+            coords = jnp.pad(coords, pad)
+            dout = jnp.pad(dout, pad)
+        f1r = f1.reshape(b, n_i, n_jp // _PB, _PB, c)
+        row_spec = pl.BlockSpec((1, 1, n_jp // _PB, _PB, c),
+                                lambda bi, ii: (bi, ii, 0, 0, 0),
+                                memory_space=pltpu.VMEM)
+        df1_kernel = functools.partial(_wcp_bwd_df1_band_kernel,
+                                       radius=radius, dims=dims)
+        df2_kernel = _wcp_bwd_df2_band_kernel
+        df1_shape = (b, n_i, n_jp // _PB, _PB, c)
+    else:
+        n_jp = n_j
+        f1r = f1.reshape(b, n_i, n_j, 1, c)
+        row_spec = pl.BlockSpec((1, 1, n_j, 1, c),
+                                lambda bi, ii: (bi, ii, 0, 0, 0),
+                                memory_space=pltpu.VMEM)
+        df1_kernel = functools.partial(_wcp_bwd_df1_kernel, radius=radius,
+                                       dims=dims)
+        df2_kernel = _wcp_bwd_df2_kernel
+        df1_shape = (b, n_i, n_j, 1, c)
+
+    doutr = dout.reshape(b, n_i, n_jp, n_lvl * k, k)
+
+    coords_spec = pl.BlockSpec((1, 1, n_jp, 2),
+                               lambda bi, ii: (bi, ii, 0, 0),
                                memory_space=pltpu.SMEM)
-    dout_spec = pl.BlockSpec((1, 1, n_j, n_lvl * k, k),
+    dout_spec = pl.BlockSpec((1, 1, n_jp, n_lvl * k, k),
                              lambda bi, ii: (bi, ii, 0, 0, 0),
                              memory_space=pltpu.VMEM)
-    row_spec = pl.BlockSpec((1, 1, n_j, 1, c),
-                            lambda bi, ii: (bi, ii, 0, 0, 0),
-                            memory_space=pltpu.VMEM)
 
     df1 = pl.pallas_call(
-        functools.partial(_wcp_bwd_df1_kernel, radius=radius, dims=dims),
-        out_shape=jax.ShapeDtypeStruct((b, n_i, n_j, 1, c), jnp.float32),
+        df1_kernel,
+        out_shape=jax.ShapeDtypeStruct(df1_shape, jnp.float32),
         grid=(b, n_i),
         in_specs=[coords_spec, dout_spec] + [
             pl.BlockSpec((1,) + f2.shape[1:], lambda bi, ii: (bi, 0, 0, 0),
@@ -458,7 +774,7 @@ def _wcp_bwd_tpu(f1, f2_levels, coords, dout, radius, interpret=False):
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
-    )(coords, doutr, *f2p).reshape(b, n_i, n_j, c)
+    )(coords, doutr, *f2p).reshape(b, n_i, n_jp, c)[:, :, :n_j]
 
     df2_out = []
     for lvl, f2 in enumerate(f2p):
@@ -466,11 +782,11 @@ def _wcp_bwd_tpu(f1, f2_levels, coords, dout, radius, interpret=False):
         # the accumulated df2 block (revisited across the i-grid) plus its
         # pipeline double-buffer exceed the default budget at level 0
         dout_l = doutr[:, :, :, lvl * k:(lvl + 1) * k, :]
-        dout_l_spec = pl.BlockSpec((1, 1, n_j, k, k),
+        dout_l_spec = pl.BlockSpec((1, 1, n_jp, k, k),
                                    lambda bi, ii: (bi, ii, 0, 0, 0),
                                    memory_space=pltpu.VMEM)
         df2_l = pl.pallas_call(
-            functools.partial(_wcp_bwd_df2_kernel, radius=radius, lvl=lvl,
+            functools.partial(df2_kernel, radius=radius, lvl=lvl,
                               dims=dims[lvl]),
             out_shape=jax.ShapeDtypeStruct(f2.shape, jnp.float32),
             grid=(b, n_i),

@@ -82,6 +82,12 @@ def interpolate_bilinear(x, size):
     transposed-matmul gradients. Realizing it through a positional gather
     (as grid_sample must) costs a serialized scatter-add in the backward
     pass, profiled at ~40 ms per resize at the flagship's level-2 shapes.
+
+    Output dtype follows ``x`` (intentional: under the bf16 policy the
+    hierarchical-supervision resizes feed bf16 consumers; the accumulation
+    itself runs in f32 before the cast, so only the final rounding is
+    dtype-dependent). Pre-round-4 the gather path returned f32-promoted
+    output; loss-side callers that need f32 should cast before calling.
     """
     ho, wo = size
     hi, wi = x.shape[-3], x.shape[-2]

@@ -1,0 +1,273 @@
+#!/usr/bin/env python3
+"""Generate a synthetic FlyingChairs-like optical-flow dataset + run configs.
+
+No real dataset ships with this environment, so the trained-quality
+evidence (QUALITY.md) uses this generator: textured objects moving with
+independent affine transforms over an affinely-moving background, with
+the exact forward flow composited by z-order — the same construction
+idea as FlyingChairs (objects + affine motions, dense ground truth),
+procedurally textured. The mapping image-pair -> flow is fully learnable,
+so a correct training stack must drive validation EPE down by orders of
+magnitude; random-noise data (as used in the CLI smoke tests) cannot
+show that.
+
+Writes, under --out (default /tmp/synth-chairs):
+  data/{train,val}/{seq:05d}-img_{1,2}.png  + -flow.flo
+  dataset.yaml / train.yaml / val.yaml / strategy.yaml / inspect.yaml
+
+then prints the main.py train invocation.
+
+Reference analogue: the FlyingChairs stage of the baseline schedule
+(reference cfg/strategy/baseline/raft/s0-chairs2.yaml; dataset layout
+src/data/dataset.py generic layout).
+"""
+
+import argparse
+import os
+import sys
+
+import cv2
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from raft_meets_dicl_tpu.data import io  # noqa: E402
+
+H, W = 384, 512
+PAD = 96  # texture canvas margin; must exceed max |displacement|
+
+
+def _smooth_texture(rs, h, w, cells):
+    """Colorful band-limited texture: low-res noise upsampled cubically."""
+    small = rs.rand(max(2, h // cells), max(2, w // cells), 3).astype(np.float32)
+    tex = cv2.resize(small, (w, h), interpolation=cv2.INTER_CUBIC)
+    return np.clip(tex, 0.0, 1.0)
+
+
+def _rand_affine(rs, max_t, max_rot_deg, scale_lo, scale_hi, cx, cy):
+    """2x3 forward map about (cx, cy): p2 = A @ p1 + b."""
+    ang = np.deg2rad(rs.uniform(-max_rot_deg, max_rot_deg))
+    s = rs.uniform(scale_lo, scale_hi)
+    ca, sa = np.cos(ang) * s, np.sin(ang) * s
+    A = np.array([[ca, -sa], [sa, ca]], np.float64)
+    t = rs.uniform(-max_t, max_t, size=2)
+    c = np.array([cx, cy], np.float64)
+    b = c - A @ c + t
+    return np.hstack([A, b[:, None]]).astype(np.float64)
+
+
+def _flow_of(M, xs, ys):
+    """Forward flow of affine M evaluated at pixel coords (xs, ys)."""
+    fx = (M[0, 0] - 1.0) * xs + M[0, 1] * ys + M[0, 2]
+    fy = M[1, 0] * xs + (M[1, 1] - 1.0) * ys + M[1, 2]
+    return np.stack([fx, fy], axis=-1).astype(np.float32)
+
+
+def _object_mask(rs, h, w):
+    """Random filled convex polygon or ellipse, anywhere in frame."""
+    mask = np.zeros((h, w), np.uint8)
+    cx, cy = rs.uniform(0.15, 0.85) * w, rs.uniform(0.15, 0.85) * h
+    size = rs.uniform(30, 90)
+    if rs.rand() < 0.5:
+        axes = (int(size), int(size * rs.uniform(0.4, 1.0)))
+        cv2.ellipse(mask, (int(cx), int(cy)), axes,
+                    rs.uniform(0, 180), 0, 360, 1, -1)
+    else:
+        k = rs.randint(3, 7)
+        ang = np.sort(rs.uniform(0, 2 * np.pi, k))
+        r = size * rs.uniform(0.5, 1.0, k)
+        pts = np.stack([cx + r * np.cos(ang), cy + r * np.sin(ang)], -1)
+        cv2.fillPoly(mask, [pts.astype(np.int32)], 1)
+    return mask.astype(bool)
+
+
+def make_pair(seed):
+    rs = np.random.RandomState(seed)
+    ch, cw = H + 2 * PAD, W + 2 * PAD
+
+    # background: moving texture on an oversized canvas so no border
+    # content ever enters the frame (keeps the affine flow exact)
+    tex = _smooth_texture(rs, ch, cw, cells=rs.randint(16, 48))
+    m_bg = _rand_affine(rs, max_t=16, max_rot_deg=4,
+                        scale_lo=0.95, scale_hi=1.05,
+                        cx=cw / 2, cy=ch / 2)
+    bg2 = cv2.warpAffine(tex, m_bg[:2], (cw, ch), flags=cv2.INTER_LINEAR)
+
+    img1 = tex[PAD:PAD + H, PAD:PAD + W].copy()
+    img2 = bg2[PAD:PAD + H, PAD:PAD + W].copy()
+
+    ys, xs = np.mgrid[0:H, 0:W].astype(np.float64)
+    # canvas coords of frame pixels (affine flow is coord-dependent)
+    flow = _flow_of(m_bg, xs + PAD, ys + PAD)
+
+    for _ in range(rs.randint(2, 5)):
+        mask1 = _object_mask(rs, H, W)
+        if mask1.sum() < 64:
+            continue
+        otex = _smooth_texture(rs, H, W, cells=rs.randint(6, 24))
+        m_obj = _rand_affine(rs, max_t=28, max_rot_deg=12,
+                             scale_lo=0.9, scale_hi=1.12,
+                             cx=W / 2, cy=H / 2)
+        layer2 = cv2.warpAffine(otex, m_obj[:2], (W, H))
+        mask2 = cv2.warpAffine(mask1.astype(np.float32), m_obj[:2],
+                               (W, H)) > 0.5
+        img1[mask1] = otex[mask1]
+        img2[mask2] = layer2[mask2]
+        flow[mask1] = _flow_of(m_obj, xs, ys)[mask1]
+
+    to8 = lambda im: (np.clip(im, 0, 1) * 255).astype(np.uint8)  # noqa: E731
+    return to8(img1), to8(img2), flow
+
+
+DATASET_YAML = """\
+name: Synthetic Chairs
+id: synth-chairs
+path: ./data
+
+layout:
+  type: generic
+  images: '{split}/{seq:05d}-img_{idx:d}.png'
+  flows: '{split}/{seq:05d}-flow.flo'
+  key: '{split}/{seq:05d}'
+
+parameters:
+  split:
+    values: [train, val]
+    sub: split
+"""
+
+SOURCE_YAML = """\
+type: augment
+
+augmentations:
+  - type: crop
+    size: [{cw}, {ch}]
+
+source:
+  type: dataset
+  spec: ./dataset.yaml
+  parameters:
+    split: {split}
+"""
+
+VAL_YAML = """\
+type: dataset
+spec: ./dataset.yaml
+parameters:
+  split: val
+"""
+
+STRATEGY_YAML = """\
+name: synth-chairs quality run
+id: dev/synth-chairs
+
+mode: continuous
+
+stages:
+  - name: "Synthetic Chairs ({epochs} epochs)"
+    id: train/synth-chairs-0
+
+    data:
+      epochs: {epochs}
+      batch-size: {batch}
+      source: ./train.yaml
+
+    validation:
+      source: ./val.yaml
+      batch-size: 2
+      images: [0, 1, 2, 3]
+
+    optimizer:
+      type: adam-w
+      parameters:
+        lr: &lr {lr}
+        weight_decay: 1.0e-4
+        eps: 1.0e-8
+
+    lr-scheduler:
+      instance:
+        - type: one-cycle
+          parameters:
+            max_lr: *lr
+            total_steps: '{{n_epochs}} * {{n_batches}} + 10'
+            pct_start: 0.05
+            cycle_momentum: false
+            anneal_strategy: linear
+
+    gradient:
+      clip:
+        type: norm
+        value: 1.0
+"""
+
+INSPECT_YAML = """\
+metrics:
+  - prefix: 'Train:S{n_stage}:{id_stage}/'
+    frequency: 10
+    metrics:
+      - type: epe
+      - type: loss
+      - type: learning-rate
+
+checkpoints:
+  path: checkpoints/
+  name: 'synth-chairs-s{n_stage}_e{n_epoch}_b{n_steps}-epe{m_EndPointError_mean:.4f}.ckpt'
+  compare: ['{m_EndPointError_mean}']
+  keep:
+    latest: 2
+    best: 2
+
+validation:
+  - type: strategy
+    frequency: epoch
+    checkpoint: true
+    tb-metrics-prefix: 'Validation:S{n_stage}:{id_stage}:{id_val}/'
+    metrics:
+      - reduce: mean
+        metric:
+          type: epe
+"""
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="/tmp/synth-chairs")
+    ap.add_argument("--train", type=int, default=1000)
+    ap.add_argument("--val", type=int, default=64)
+    ap.add_argument("--epochs", type=int, default=30)
+    ap.add_argument("--batch", type=int, default=6)
+    ap.add_argument("--lr", type=float, default=4.0e-4)
+    args = ap.parse_args()
+
+    for split, n, base in (("train", args.train, 0),
+                           ("val", args.val, 10_000_000)):
+        d = os.path.join(args.out, "data", split)
+        os.makedirs(d, exist_ok=True)
+        for i in range(n):
+            img1, img2, flow = make_pair(base + i)
+            cv2.imwrite(os.path.join(d, f"{i:05d}-img_1.png"), img1[..., ::-1])
+            cv2.imwrite(os.path.join(d, f"{i:05d}-img_2.png"), img2[..., ::-1])
+            io.write_flow_mb(os.path.join(d, f"{i:05d}-flow.flo"), flow)
+            if i % 200 == 0:
+                print(f"{split}: {i}/{n}", flush=True)
+
+    cfg = {
+        "dataset.yaml": DATASET_YAML,
+        "train.yaml": SOURCE_YAML.format(cw=496, ch=368, split="train"),
+        "val.yaml": VAL_YAML,
+        "strategy.yaml": STRATEGY_YAML.format(
+            epochs=args.epochs, batch=args.batch, lr=args.lr),
+        "inspect.yaml": INSPECT_YAML,
+    }
+    for name, text in cfg.items():
+        with open(os.path.join(args.out, name), "w") as f:
+            f.write(text)
+
+    print("dataset ready. train with:")
+    print(f"  python main.py train -d {args.out}/strategy.yaml "
+          f"-m cfg/model/raft-baseline.yaml -i {args.out}/inspect.yaml "
+          f"-o runs-quality")
+
+
+if __name__ == "__main__":
+    main()

@@ -296,3 +296,10 @@ def test_sample_window_matches_grid_sample_definition():
     da = jax.grad(lambda m: (sample_window_gs(m, raw, 3) * g).sum())(f2)
     db = jax.grad(lambda m: (sample_window(m, raw, 3) * g).sum())(f2)
     np.testing.assert_allclose(np.asarray(db), np.asarray(da), atol=1e-5)
+
+    # coords gradient: the fractional-lerp terms (fx, fy) are the only
+    # coords-differentiable path through the patch decomposition — the
+    # iterative models' flow updates backprop through exactly this
+    dca = jax.grad(lambda c: (sample_window_gs(f2, c, 3) * g).sum())(raw)
+    dcb = jax.grad(lambda c: (sample_window(f2, c, 3) * g).sum())(raw)
+    np.testing.assert_allclose(np.asarray(dcb), np.asarray(dca), atol=1e-4)
