@@ -290,7 +290,12 @@ class WipMultiscaleLoss(Loss):
         return loss / len(flows)
 
     def compute(self, model, result, target, valid, weights, ord=2,
-                mode="bilinear", valid_range=None):
+                mode="bilinear", alpha=1.0, valid_range=None):
+        # ``alpha`` is accepted (and ignored) for config round-tripping:
+        # the reference's get_config advertises it on every multiscale
+        # variant while only the corr-hinge/corr-mse subclasses consume
+        # it (reference wip_warp.py:477,544,600) — a full config written
+        # by gencfg must load back through this base class
         return self._flow_loss(result, target, valid, weights, ord, mode,
                                valid_range)
 

@@ -634,15 +634,16 @@ def _wcp_pad_f2(f2_levels, radius):
     )
 
 
-def _wcp_fwd_interpret(f1, f2_levels, coords, radius):
+def _wcp_fwd_interpret(f1, f2_levels, coords, radius, band=None):
     """Interpreter-mode forward (kernel correctness tests off-TPU)."""
-    return _wcp_fwd_tpu(f1, tuple(f2_levels), coords, radius, interpret=True)
+    return _wcp_fwd_tpu(f1, tuple(f2_levels), coords, radius,
+                        interpret=True, band=band)
 
 
-def _wcp_bwd_interpret(f1, f2_levels, coords, dout, radius):
+def _wcp_bwd_interpret(f1, f2_levels, coords, dout, radius, band=None):
     """Interpreter-mode backward (kernel correctness tests off-TPU)."""
     return _wcp_bwd_tpu(f1, tuple(f2_levels), coords, dout, radius,
-                        interpret=True)
+                        interpret=True, band=band)
 
 
 def _wcp_fwd_tpu(f1, f2_levels, coords, radius, interpret=False,

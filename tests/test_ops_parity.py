@@ -228,9 +228,12 @@ class TestPool:
         np.testing.assert_allclose(ours, t.permute(0, 2, 3, 1).numpy(), atol=1e-6)
 
 
-def test_windowed_corr_pyramid_kernel_matches_reference():
+@pytest.mark.parametrize("band", [False, True])
+def test_windowed_corr_pyramid_kernel_matches_reference(band):
     """The fused windowed-correlation kernel (interpreter mode off-TPU)
-    matches the per-level XLA composition, forward and backward."""
+    matches the per-level XLA composition, forward and backward — both
+    the per-position path and the band-shared chunk path (whose mixed
+    per-chunk flow spread exercises the shared/fallback lax.cond)."""
     from raft_meets_dicl_tpu.ops import pallas as pk
     from raft_meets_dicl_tpu.ops.pool import avg_pool2d
 
@@ -250,14 +253,15 @@ def test_windowed_corr_pyramid_kernel_matches_reference():
               + jnp.asarray(rs.randn(b, h, w, 2) * 8, jnp.float32))
 
     ref = pk._wcp_reference(f1, levels, coords, 4)
-    out = pk._wcp_fwd_interpret(f1, levels, coords, 4)
+    out = pk._wcp_fwd_interpret(f1, levels, coords, 4, band=band)
     assert np.allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
 
     dout = jnp.asarray(rs.randn(*ref.shape), jnp.float32)
     _, vjp = jax.vjp(lambda a, bb: pk._wcp_reference(a, bb, coords, 4),
                      f1, levels)
     df1_r, df2_r = vjp(dout)
-    df1, df2 = pk._wcp_bwd_interpret(f1, levels, coords, dout, 4)
+    df1, df2 = pk._wcp_bwd_interpret(f1, levels, coords, dout, 4,
+                                     band=band)
     assert np.allclose(np.asarray(df1), np.asarray(df1_r), atol=1e-4)
     for got, want in zip(df2, df2_r):
         assert np.allclose(np.asarray(got), np.asarray(want), atol=1e-4)
