@@ -81,6 +81,81 @@ class Repeat(Collection):
         return f"{self.source.description()}, repeat times {self.times}"
 
 
+class Cache(Collection):
+    """In-memory memoization of decoded samples by index.
+
+    TPU-native substitute for the reference's multi-worker torch
+    DataLoader (src/data/__init__.py collate path): on few-core TPU VM
+    hosts the Python image-decode path cannot be parallelized away, so
+    repeated epochs memoize the decoded (pre-augmentation) arrays
+    instead — place UNDER `augment` so randomized augmentations stay
+    fresh per epoch. First epoch pays the decode, later epochs are
+    memory-bandwidth only. Measured on the 1-core dev box: 127 ms ->
+    ~3 ms per sample.
+
+    ``budget-gib`` caps the resident size (default 16 GiB); beyond it,
+    further samples pass through uncached (a warning is logged once).
+    """
+
+    type = "cache"
+
+    @classmethod
+    def from_config(cls, path, cfg):
+        from . import config as data_config
+
+        cls._typecheck(cfg)
+        return cls(data_config.load(path, cfg["source"]),
+                   budget_gib=cfg.get("budget-gib", 16.0))
+
+    def __init__(self, source, budget_gib=16.0):
+        super().__init__()
+        self.source = source
+        self.budget = int(budget_gib * 2 ** 30)
+        self._cache = {}
+        self._bytes = 0
+        self._warned = False
+
+    def get_config(self):
+        return {
+            "type": self.type,
+            "budget-gib": self.budget / 2 ** 30,
+            "source": self.source.get_config(),
+        }
+
+    def __getitem__(self, index):
+        hit = self._cache.get(index)
+        if hit is not None:
+            return hit
+
+        sample = self.source[index]
+        img1, img2, flow, valid, meta = sample
+        size = sum(a.nbytes for a in (img1, img2, flow, valid)
+                   if a is not None)
+        if self._bytes + size <= self.budget:
+            for a in (img1, img2, flow, valid):
+                # loud failure instead of silent cache corruption should
+                # any consumer ever mutate a sample in place
+                if a is not None and a.flags.owndata:
+                    a.setflags(write=False)
+            self._cache[index] = sample
+            self._bytes += size
+        elif not self._warned:
+            self._warned = True
+            import logging
+
+            logging.getLogger("rmdtpu").warning(
+                f"sample cache budget ({self.budget / 2**30:.1f} GiB) "
+                f"exhausted after {len(self._cache)} samples; further "
+                f"samples stream uncached")
+        return sample
+
+    def __len__(self):
+        return len(self.source)
+
+    def description(self):
+        return f"{self.source.description()}, cached"
+
+
 class Subset(Collection):
     """Random subset with replacement, drawn once at construction."""
 

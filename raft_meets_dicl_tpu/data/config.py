@@ -10,14 +10,14 @@ from pathlib import Path
 
 from ..utils import config
 from .augment import Augment
-from .combinators import Concat, Repeat, Subset
+from .combinators import Cache, Concat, Repeat, Subset
 from .dataset import Dataset
 from .fw_bw import ForwardsBackwardsBatch, ForwardsBackwardsEstimate
 
 _TYPES = {
     cls.type: cls
     for cls in (
-        Dataset, Augment, Concat, Repeat, Subset,
+        Dataset, Augment, Cache, Concat, Repeat, Subset,
         ForwardsBackwardsBatch, ForwardsBackwardsEstimate,
     )
 }

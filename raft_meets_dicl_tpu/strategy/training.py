@@ -315,6 +315,7 @@ class TrainingContext:
 
         self._accum = 0
         self._in_step = False
+        self._pending_finite = None
 
         self.inspector.on_stage_start(log, self, stage)
 
@@ -359,11 +360,20 @@ class TrainingContext:
                 break
 
         self.log = log
+        self._flush_finite_check(log)
 
         for s in self.lr_sched_epoch:
             s.step()
 
         self.inspector.on_epoch(log, self, stage, epoch)
+
+    def _flush_finite_check(self, log):
+        """Resolve the deferred finite flag of the epoch's last step
+        before validation/checkpointing can observe a poisoned state."""
+        prev, self._pending_finite = self._pending_finite, None
+        if prev is not None and not bool(prev[0]):
+            self._dump_failed(log, prev[1], prev[2])
+            raise RuntimeError("non-finite flow values detected")
 
     def run_instance(self, log, stage, epoch, i, img1, img2, flow, valid, meta):
         accumulate = stage.gradient.accumulate
@@ -398,10 +408,24 @@ class TrainingContext:
 
         self.state, aux = self.step_fn(self.state, lr, *batch)
 
-        # validate output, check for non-finite numbers
-        if self.validate and not bool(aux["finite"]):
-            self._dump_failed(log, stage, epoch)
-            raise RuntimeError("non-finite flow values detected")
+        # validate output, check for non-finite numbers — DEFERRED by one
+        # step: bool(finite) is a device->host fetch, and fetching the
+        # freshly-dispatched step would serialize every step on the
+        # backend's round-trip latency (on the tunneled TPU that latency,
+        # not compute, dominated the epoch). Checking the PREVIOUS step's
+        # flag after dispatching this one overlaps the fetch with device
+        # compute; non-finite values persist through the optimizer state,
+        # so nothing is missed — detection just fires one step later
+        # (_check_finite flushes the last pending flag at epoch end).
+        if self.validate:
+            prev = self._pending_finite
+            self._pending_finite = (aux["finite"], stage, epoch)
+            if prev is not None and not bool(prev[0]):
+                self._dump_failed(log, prev[1], prev[2])
+                raise RuntimeError(
+                    "non-finite flow values detected (flagged one step "
+                    "after the producing step; state dump includes the "
+                    "poisoned update)")
 
         loss = aux["loss"]
 

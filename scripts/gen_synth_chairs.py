@@ -144,17 +144,21 @@ augmentations:
     size: [{cw}, {ch}]
 
 source:
-  type: dataset
-  spec: ./dataset.yaml
-  parameters:
-    split: {split}
+  type: cache
+  source:
+    type: dataset
+    spec: ./dataset.yaml
+    parameters:
+      split: {split}
 """
 
 VAL_YAML = """\
-type: dataset
-spec: ./dataset.yaml
-parameters:
-  split: val
+type: cache
+source:
+  type: dataset
+  spec: ./dataset.yaml
+  parameters:
+    split: val
 """
 
 STRATEGY_YAML = """\
