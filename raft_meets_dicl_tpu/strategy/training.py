@@ -27,6 +27,44 @@ from .checkpoint import Checkpoint, Iteration, State
 from .spec import Stage, Strategy
 
 
+def _device_prefetch(samples, put, depth=2):
+    """Pipeline host batches onto the device ahead of consumption.
+
+    On a remote/tunneled backend the per-step host->device input
+    transfer (tens of MB per batch) otherwise serializes with compute —
+    measured as the dominant step cost on the axon tunnel. A background
+    thread loads and ``put``s up to ``depth`` batches ahead; the main
+    loop receives (host_batch, device_batch, meta) with transfers
+    already in flight. Loader exceptions re-raise at the consumption
+    point.
+    """
+    import queue
+    import threading
+
+    q = queue.Queue(maxsize=depth)
+    _END = object()
+
+    def worker():
+        try:
+            for img1, img2, flow, valid, meta in samples:
+                host = (img1, img2, flow, valid)
+                q.put((host, put(host), meta))
+        except BaseException as e:  # noqa: BLE001 - re-raised by consumer
+            q.put((_END, e, None))
+            return
+        q.put((_END, None, None))
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    while True:
+        host, dev, meta = q.get()
+        if host is _END:
+            if dev is not None:
+                raise dev
+            return
+        yield host, dev, meta
+
+
 class _StepResult:
     """Minimal Result view over the train step's aux outputs."""
 
@@ -313,9 +351,15 @@ class TrainingContext:
             external_lr=True, donate=True, with_grads=with_grads,
         )
 
+        import os
+
         self._accum = 0
         self._in_step = False
         self._pending_finite = None
+        # finite-check cadence (steps); 1 restores the check-every-step
+        # behavior for debugging
+        self._finite_every = max(
+            1, int(os.environ.get("RMD_FINITE_CHECK_EVERY", "10")))
 
         self.inspector.on_stage_start(log, self, stage)
 
@@ -350,11 +394,33 @@ class TrainingContext:
         self.model_adapter.on_epoch(stage, epoch, **stage.model_on_epoch_args)
         self.inspector.on_epoch_start(log, self, stage, epoch)
 
-        for i, (img1, img2, flow, valid, meta) in enumerate(samples):
+        base_put = ((lambda b: shard_batch(b, self.mesh))
+                    if self.mesh is not None else jax.device_put)
+        # wire compression: when the model computes its encoders in bf16
+        # anyway (mixed-precision policy), transferring the normalized
+        # images as bf16 halves the dominant host->device bytes without
+        # changing the effective numerics (the first conv casts to bf16
+        # regardless); flow/valid stay exact. RMD_WIRE_BF16=0 opts out.
+        import os as _os
+
+        if (getattr(getattr(self.model, "module", None),
+                    "mixed_precision", False)
+                and _os.environ.get("RMD_WIRE_BF16", "1") != "0"):
+            import jax.numpy as jnp
+
+            def put(b, _base=base_put):
+                img1, img2, flow, valid = b
+                return _base((np.asarray(img1, jnp.bfloat16),
+                              np.asarray(img2, jnp.bfloat16), flow, valid))
+        else:
+            put = base_put
+
+        for i, (host, dev, meta) in enumerate(
+                _device_prefetch(samples, put)):
             log_ = log.new(f"step {self.step}", sep=", ")
             self.log = log_
 
-            self.run_instance(log_, stage, epoch, i, img1, img2, flow, valid, meta)
+            self.run_instance(log_, stage, epoch, i, host, dev, meta)
 
             if self.step_limit is not None and self.step >= self.step_limit:
                 break
@@ -375,8 +441,9 @@ class TrainingContext:
             self._dump_failed(log, prev[1], prev[2])
             raise RuntimeError("non-finite flow values detected")
 
-    def run_instance(self, log, stage, epoch, i, img1, img2, flow, valid, meta):
+    def run_instance(self, log, stage, epoch, i, host, dev, meta):
         accumulate = stage.gradient.accumulate
+        img1, img2, flow, valid = host
 
         if not self._in_step:
             self.inspector.on_step_start(log, self, stage, epoch, i)
@@ -399,33 +466,32 @@ class TrainingContext:
             lr = s.lr()
         self.last_lr = lr
 
-        batch = (img1, img2, flow, valid)
-        if self.mesh is not None:
-            batch = shard_batch(batch, self.mesh)
-
         self.inspector.on_batch_start(log, self, stage, epoch, i, img1, img2,
                                       flow, valid, meta)
 
-        self.state, aux = self.step_fn(self.state, lr, *batch)
+        self.state, aux = self.step_fn(self.state, lr, *dev)
 
-        # validate output, check for non-finite numbers — DEFERRED by one
-        # step: bool(finite) is a device->host fetch, and fetching the
-        # freshly-dispatched step would serialize every step on the
+        # validate output, check for non-finite numbers — DEFERRED and
+        # AMORTIZED: bool(finite) is a device->host fetch, and fetching
+        # every freshly-dispatched step would serialize the loop on the
         # backend's round-trip latency (on the tunneled TPU that latency,
-        # not compute, dominated the epoch). Checking the PREVIOUS step's
-        # flag after dispatching this one overlaps the fetch with device
-        # compute; non-finite values persist through the optimizer state,
-        # so nothing is missed — detection just fires one step later
-        # (_check_finite flushes the last pending flag at epoch end).
+        # not compute, dominated the epoch). Only the latest step's flag
+        # is fetched, every _finite_every steps; NaNs/infs are absorbing
+        # through the optimizer state (NaN grads -> NaN clip scale ->
+        # NaN params), so a poisoned step always trips a later check —
+        # detection just fires up to _finite_every-1 steps late, and
+        # _flush_finite_check resolves the epoch's last step before
+        # validation or checkpointing can observe the state.
         if self.validate:
-            prev = self._pending_finite
             self._pending_finite = (aux["finite"], stage, epoch)
-            if prev is not None and not bool(prev[0]):
-                self._dump_failed(log, prev[1], prev[2])
-                raise RuntimeError(
-                    "non-finite flow values detected (flagged one step "
-                    "after the producing step; state dump includes the "
-                    "poisoned update)")
+            if (i + 1) % self._finite_every == 0:
+                prev, self._pending_finite = self._pending_finite, None
+                if not bool(prev[0]):
+                    self._dump_failed(log, prev[1], prev[2])
+                    raise RuntimeError(
+                        "non-finite flow values detected (flagged on a "
+                        "later step than the producing one; the state "
+                        "dump includes the poisoned updates)")
 
         loss = aux["loss"]
 
