@@ -128,7 +128,8 @@ def evaluate(model, variables, data, model_args=None, show_progress=True,
     if show_progress:
         data = utils.logging.progress(data, unit="batch", leave=False)
 
-    for img1, img2, flow, valid, meta in data:
+    def dispatch(item):
+        img1, img2, flow, valid, meta = item
         batch = img1.shape[0]
 
         j1, j2 = jnp.asarray(img1), jnp.asarray(img2)
@@ -141,6 +142,16 @@ def evaluate(model, variables, data, model_args=None, show_progress=True,
                 j2 = jnp.concatenate([j2, jnp.tile(j2[-1:], [pad] + reps)])
 
         out, final = step(variables, j1, j2)
+        return item, out, final
+
+    def drain(dispatched):
+        (img1, img2, flow, valid, meta), out, final = dispatched
+        batch = img1.shape[0]
+        # device_get blocks the host, not the device — with the next
+        # batch already dispatched (below) the result download and the
+        # host-side metrics overlap its compute, instead of the strict
+        # upload -> compute -> download serialization per batch that
+        # dominated validation wall time on the tunneled backend
         out, final = jax.device_get((out, final))
 
         result = adapter.wrap_result(out, img1.shape[1:3])
@@ -155,3 +166,12 @@ def evaluate(model, variables, data, model_args=None, show_progress=True,
                 output=result.output(b),
                 meta=meta[b],
             )
+
+    pending = None
+    for item in data:
+        dispatched = dispatch(item)
+        if pending is not None:
+            yield from drain(pending)
+        pending = dispatched
+    if pending is not None:
+        yield from drain(pending)

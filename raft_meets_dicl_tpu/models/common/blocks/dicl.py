@@ -42,6 +42,7 @@ class ConvBlock(nn.Module):
     norm_type: str = "batch"
     num_groups: int = 8
     dtype: Any = None
+    bn_splits: int = 1
 
     @nn.compact
     def __call__(self, x, train=False, frozen_bn=False):
@@ -79,8 +80,8 @@ class ConvBlock(nn.Module):
                 use_bias=False,
                 dtype=self.dtype,
             )(x)
-        x = Norm2d(self.norm_type, self.num_groups, dtype=self.dtype)(
-            x, train and not frozen_bn)
+        x = Norm2d(self.norm_type, self.num_groups, dtype=self.dtype,
+                   splits=self.bn_splits)(x, train and not frozen_bn)
         return nn.relu(x)
 
 
@@ -97,6 +98,7 @@ class ConvBlockTransposed(nn.Module):
     norm_type: str = "batch"
     num_groups: int = 8
     dtype: Any = None
+    bn_splits: int = 1
 
     @nn.compact
     def __call__(self, x, train=False, frozen_bn=False):
@@ -104,8 +106,8 @@ class ConvBlockTransposed(nn.Module):
             self.c_out, (4, 4), strides=(2, 2), padding="SAME", use_bias=False,
             dtype=self.dtype,
         )(x)
-        x = Norm2d(self.norm_type, self.num_groups, dtype=self.dtype)(
-            x, train and not frozen_bn)
+        x = Norm2d(self.norm_type, self.num_groups, dtype=self.dtype,
+                   splits=self.bn_splits)(x, train and not frozen_bn)
         return nn.relu(x)
 
 
@@ -114,6 +116,7 @@ class GaConv2xBlock(nn.Module):
 
     c_out: int
     norm_type: str = "batch"
+    bn_splits: int = 1
 
     @nn.compact
     def __call__(self, x, res, train=False, frozen_bn=False):
@@ -125,7 +128,8 @@ class GaConv2xBlock(nn.Module):
         x = jnp.concatenate((x, res), axis=-1)
 
         x = nn.Conv(self.c_out, (3, 3), use_bias=False)(x)
-        x = Norm2d(self.norm_type, 8)(x, train and not frozen_bn)
+        x = Norm2d(self.norm_type, 8, splits=self.bn_splits)(
+            x, train and not frozen_bn)
         return nn.relu(x)
 
 
@@ -134,6 +138,7 @@ class GaConv2xBlockTransposed(nn.Module):
 
     c_out: int
     norm_type: str = "batch"
+    bn_splits: int = 1
 
     @nn.compact
     def __call__(self, x, res, train=False, frozen_bn=False):
@@ -147,7 +152,8 @@ class GaConv2xBlockTransposed(nn.Module):
         x = jnp.concatenate((x, res), axis=-1)
 
         x = nn.Conv(self.c_out, (3, 3), use_bias=False)(x)
-        x = Norm2d(self.norm_type, 8)(x, train and not frozen_bn)
+        x = Norm2d(self.norm_type, 8, splits=self.bn_splits)(
+            x, train and not frozen_bn)
         return nn.relu(x)
 
 

@@ -50,40 +50,45 @@ class FeatureEncoderGa(nn.Module):
             x = jnp.concatenate(x, axis=0)
 
         nt = self.norm_type
+        # paired inputs fold (img1, img2) into one 2N batch for conv
+        # efficiency, but the REFERENCE runs the two images through
+        # separate encoder calls (src/models/impls/dicl.py:277-278) —
+        # live batch-norm statistics must therefore be per-image
+        sp = 2 if paired else 1
 
         # stem: three 3x3 convs, middle one strided (→ level 0, H/2)
-        x = ConvBlock(_CHANNELS[0], norm_type=nt)(x, train, frozen_bn)
-        x = ConvBlock(_CHANNELS[0], stride=2, norm_type=nt)(x, train, frozen_bn)
-        x = ConvBlock(_CHANNELS[0], norm_type=nt)(x, train, frozen_bn)
+        x = ConvBlock(_CHANNELS[0], norm_type=nt, bn_splits=sp)(x, train, frozen_bn)
+        x = ConvBlock(_CHANNELS[0], stride=2, norm_type=nt, bn_splits=sp)(x, train, frozen_bn)
+        x = ConvBlock(_CHANNELS[0], norm_type=nt, bn_splits=sp)(x, train, frozen_bn)
 
         res = {0: x}
 
         # first down-ladder
         for i in range(1, depth + 1):
-            x = ConvBlock(_CHANNELS[i], stride=2, norm_type=nt)(x, train, frozen_bn)
+            x = ConvBlock(_CHANNELS[i], stride=2, norm_type=nt, bn_splits=sp)(x, train, frozen_bn)
             res[i] = x
 
         # up-ladder, refreshing the skip features
         for i in range(depth, 0, -1):
-            x = GaConv2xBlockTransposed(_CHANNELS[i - 1], norm_type=nt)(
+            x = GaConv2xBlockTransposed(_CHANNELS[i - 1], norm_type=nt, bn_splits=sp)(
                 x, res[i - 1], train, frozen_bn
             )
             res[i - 1] = x
 
         # second down-ladder, fusing the refreshed skips
         for i in range(1, depth + 1):
-            x = GaConv2xBlock(_CHANNELS[i], norm_type=nt)(x, res[i], train, frozen_bn)
+            x = GaConv2xBlock(_CHANNELS[i], norm_type=nt, bn_splits=sp)(x, res[i], train, frozen_bn)
             res[i] = x
 
         # final up-ladder with output heads at the requested levels
         outputs = {}
         for i in range(depth, min(out_levels), -1):
-            x = GaConv2xBlockTransposed(_CHANNELS[i - 1], norm_type=nt)(
+            x = GaConv2xBlockTransposed(_CHANNELS[i - 1], norm_type=nt, bn_splits=sp)(
                 x, res[i - 1], train, frozen_bn
             )
             if i - 1 in out_levels:
                 if self.heads:
-                    outputs[i - 1] = ConvBlock(self.output_dim, norm_type=nt)(
+                    outputs[i - 1] = ConvBlock(self.output_dim, norm_type=nt, bn_splits=sp)(
                         x, train, frozen_bn
                     )
                 else:

@@ -11,6 +11,7 @@ wrapper passes ``train=False``-equivalent ``use_running_average`` into
 from typing import Any
 
 import flax.linen as nn
+import jax.numpy as jnp
 
 NORM_TYPES = ("group", "batch", "instance", "none")
 
@@ -26,6 +27,14 @@ class Norm2d(nn.Module):
     ty: str
     num_groups: int = 8
     dtype: Any = None
+    # batch norm only: compute live statistics over `splits` equal
+    # leading-axis chunks instead of the whole batch. Encoders that fold
+    # an (img1, img2) pair into one 2N batch for conv efficiency set
+    # splits=2 when the REFERENCE runs the two images through separate
+    # calls (per-image stats, sequential running-stat updates) — only
+    # the norm couples the pair, so only the norm needs to split
+    # (reference src/models/impls/dicl.py:277-278).
+    splits: int = 1
 
     @nn.compact
     def __call__(self, x, train=False):
@@ -34,10 +43,20 @@ class Norm2d(nn.Module):
                 num_groups=self.num_groups, epsilon=1e-5, dtype=self.dtype
             )(x)
         if self.ty == "batch":
-            return nn.BatchNorm(
+            bn = nn.BatchNorm(
                 use_running_average=not train, momentum=0.9, epsilon=1e-5,
                 dtype=self.dtype,
-            )(x)
+            )
+            if train and self.splits > 1:
+                # one shared BatchNorm instance applied per chunk: same
+                # parameter tree, per-chunk statistics, and the second
+                # call's running-stat update reads the first's result —
+                # exactly the reference's sequential per-image calls
+                n = x.shape[0] // self.splits
+                return jnp.concatenate(
+                    [bn(x[i * n:(i + 1) * n]) for i in range(self.splits)],
+                    axis=0)
+            return bn(x)
         if self.ty == "instance":
             # per-sample, per-channel over spatial dims; non-affine like torch
             return nn.GroupNorm(
