@@ -403,6 +403,12 @@ class TrainingContext:
         # regardless); flow/valid stay exact. RMD_WIRE_BF16=0 opts out.
         import os as _os
 
+        if _os.environ.get("RMD_PREFETCH_PUT", "1") == "0":
+            # host-only prefetch: overlap decode but let jit do the
+            # implicit arg transfer (fallback for backends whose explicit
+            # device_put path misbehaves)
+            base_put = lambda b: b  # noqa: E731
+
         if (getattr(getattr(self.model, "module", None),
                     "mixed_precision", False)
                 and _os.environ.get("RMD_WIRE_BF16", "1") != "0"):
@@ -427,6 +433,17 @@ class TrainingContext:
 
         self.log = log
         self._flush_finite_check(log)
+
+        import os as _os
+
+        if _os.environ.get("RMD_DEBUG_MEM"):
+            rss = 0.0
+            with open("/proc/self/status") as f:
+                for line in f:
+                    if line.startswith("VmRSS:"):
+                        rss = int(line.split()[1]) / 2**20
+            live = len(jax.live_arrays())
+            log.info(f"mem: rss {rss:.2f} GiB, live jax arrays {live}")
 
         for s in self.lr_sched_epoch:
             s.step()
