@@ -17,6 +17,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from ...ops.corr import correlation_pyramid_direct, lookup_pyramid_levels
 from ...ops.pallas import windowed_corr_pyramid
 from ...ops.pool import avg_pool2d
 from ...ops.upsample import interpolate_bilinear
@@ -36,6 +37,7 @@ class _FsStep(nn.Module):
     upnet: bool
     mask_costs: Tuple[int, ...]
     full_shape: Tuple[int, int]
+    volume: bool = False
     dtype: Any = None
 
     @nn.compact
@@ -44,14 +46,24 @@ class _FsStep(nn.Module):
         coords1 = jax.lax.stop_gradient(coords1)
         flow = coords1 - coords0
 
-        # on-the-fly windowed dot-product against the pooled pyramid — the
-        # fused kernel (ops/pallas.py) on TPU, per-level windowed
-        # correlation off it; the reference lookup skips the sqrt(C)
-        # normalization (raft_fs.py:76)
-        corr = windowed_corr_pyramid(
-            fmap1, pyramid, coords1, self.corr_radius,
-            mask_costs=self.mask_costs, normalize=False,
-        )
+        if self.volume:
+            # small-enough shapes: ``pyramid`` is the materialized volume
+            # pyramid, amortized across iterations — same math (pooling
+            # commutes with the dot product), ~4x the throughput of the
+            # per-step windowed computation at training crops
+            corr = lookup_pyramid_levels(pyramid, coords1,
+                                         self.corr_radius,
+                                         mask_costs=self.mask_costs)
+        else:
+            # on-the-fly windowed dot-product against the pooled feature
+            # pyramid — the fused kernel (ops/pallas.py) on TPU,
+            # per-level windowed correlation off it; O(B·H·W·C) memory at
+            # any resolution. The reference lookup skips the sqrt(C)
+            # normalization (raft_fs.py:76) in both realizations.
+            corr = windowed_corr_pyramid(
+                fmap1, pyramid, coords1, self.corr_radius,
+                mask_costs=self.mask_costs, normalize=False,
+            )
 
         h, d = BasicUpdateBlock(self.recurrent_channels, dtype=self.dtype)(
             h, x, corr, flow)
@@ -106,10 +118,33 @@ class RaftFsModule(nn.Module):
         # windowed-correlation kernel's VMEM blocks (the accumulation is
         # f32 inside the kernel)
 
-        # avg-pooled second-frame feature pyramid (raft_fs.py:26-31)
-        pyramid = [fmap2]
-        for _ in range(1, self.corr_levels):
-            pyramid.append(avg_pool2d(pyramid[-1], 2))
+        # strategy dispatch: the windowed computation exists so the
+        # O(H²W²) volume never has to — but where the volume DOES fit,
+        # materializing it once and looking it up per iteration is ~4x
+        # faster at training crops (the windowed kernel is gather-bound).
+        # Identical math either way (pooling/bilinear commute with the
+        # dot product); the estimate charges 2x for the backward's
+        # volume-gradient accumulation. RMD_FS_VOLUME_GIB tunes the
+        # budget (0 forces the windowed path everywhere).
+        import os
+
+        b0, hc0, wc0, _ = fmap1.shape
+        itemsize = 2 if dt is not None else 4
+        vol_bytes = sum(
+            b0 * hc0 * wc0 * (hc0 // 2 ** l) * (wc0 // 2 ** l) * itemsize
+            for l in range(self.corr_levels)
+        )
+        budget = float(os.environ.get("RMD_FS_VOLUME_GIB", "2.0")) * 2 ** 30
+        use_volume = 2 * vol_bytes <= budget
+
+        if use_volume:
+            pyramid = correlation_pyramid_direct(
+                fmap1, fmap2, self.corr_levels, dtype=dt, normalize=False)
+        else:
+            # avg-pooled second-frame feature pyramid (raft_fs.py:26-31)
+            pyramid = [fmap2]
+            for _ in range(1, self.corr_levels):
+                pyramid.append(avg_pool2d(pyramid[-1], 2))
 
         ctx = cnet(img1, train, frozen_bn)
         h = jnp.tanh(ctx[..., :hdim])
@@ -134,6 +169,7 @@ class RaftFsModule(nn.Module):
             upnet=upnet,
             mask_costs=tuple(mask_costs),
             full_shape=(img1.shape[1], img1.shape[2]),
+            volume=use_volume,
             dtype=dt,
         )
 

@@ -64,7 +64,8 @@ def _pool2x_spatial(fmap):
     return x.astype(fmap.dtype)
 
 
-def correlation_pyramid_direct(fmap1, fmap2, num_levels=4, dtype=None):
+def correlation_pyramid_direct(fmap1, fmap2, num_levels=4, dtype=None,
+                               normalize=True):
     """Pyramid of all-pairs volumes against progressively pooled frame-2 maps.
 
     Mathematically identical to ``correlation_pyramid(all_pairs_correlation
@@ -74,16 +75,19 @@ def correlation_pyramid_direct(fmap1, fmap2, num_levels=4, dtype=None):
     O(H²W²) volume (whose oddly-tiled intermediates cost layout copies in
     both passes; profiled ~8 ms/step at the bench config). ``dtype`` casts
     each level after the f32-accumulated einsum (bf16 under the mixed
-    policy halves volume HBM traffic).
+    policy halves volume HBM traffic). ``normalize=False`` skips the
+    1/sqrt(C) scale (the raft/fs lookup convention, reference
+    raft_fs.py:76).
     """
     c = fmap1.shape[-1]
-    inv_sqrt_c = 1.0 / jnp.sqrt(jnp.asarray(c, jnp.float32))
+    scale = (1.0 / jnp.sqrt(jnp.asarray(c, jnp.float32))
+             if normalize else jnp.asarray(1.0, jnp.float32))
 
     pyramid = []
     f2 = fmap2
     for lvl in range(num_levels):
         corr = jnp.einsum("bijc,bklc->bijkl", fmap1, f2,
-                          preferred_element_type=jnp.float32) * inv_sqrt_c
+                          preferred_element_type=jnp.float32) * scale
         pyramid.append(corr.astype(dtype) if dtype is not None else corr)
         if lvl + 1 < num_levels:
             f2 = _pool2x_spatial(f2)
