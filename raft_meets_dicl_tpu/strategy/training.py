@@ -434,8 +434,6 @@ class TrainingContext:
         self.log = log
         self._flush_finite_check(log)
 
-        import os as _os
-
         if _os.environ.get("RMD_DEBUG_MEM"):
             rss = 0.0
             with open("/proc/self/status") as f:

@@ -48,7 +48,12 @@ def test_raft_sl_forward():
     assert models.config.load_model(cfg).get_config() == cfg
 
 
-def test_raft_fs_forward():
+@pytest.mark.parametrize("volume_gib", ["0", "2.0"])
+def test_raft_fs_forward(volume_gib, monkeypatch):
+    """Both correlation strategies of the adaptive dispatch: '0' forces
+    the windowed/Pallas-path _FsStep branch, '2.0' takes the
+    materialized-volume branch at this toy shape."""
+    monkeypatch.setenv("RMD_FS_VOLUME_GIB", volume_gib)
     m = models.config.load_model({
         "type": "raft/fs",
         "parameters": {"corr-levels": 3, "corr-radius": 2, "corr-channels": 16,
@@ -67,6 +72,28 @@ def test_raft_fs_forward():
 
     cfg = m.get_config()
     assert models.config.load_model(cfg).get_config() == cfg
+
+
+def test_raft_fs_volume_dispatch_matches_windowed(monkeypatch):
+    """The two correlation strategies compute the same model function
+    (pooling/bilinear interpolation commute with the dot product)."""
+    cfg = {
+        "type": "raft/fs",
+        "parameters": {"corr-levels": 3, "corr-radius": 2, "corr-channels": 16,
+                       "context-channels": 8, "recurrent-channels": 8},
+    }
+    img = _img()
+
+    monkeypatch.setenv("RMD_FS_VOLUME_GIB", "2.0")
+    m_vol = models.config.load_model(cfg)
+    v = m_vol.init(RNG, img, img, iterations=1)
+    out_vol = m_vol.apply(v, img, img, iterations=3)
+
+    monkeypatch.setenv("RMD_FS_VOLUME_GIB", "0")
+    out_win = models.config.load_model(cfg).apply(v, img, img, iterations=3)
+
+    for a, b in zip(out_vol, out_win):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
 
 
 def test_raft_fs_matches_windowed_lookup_semantics():
