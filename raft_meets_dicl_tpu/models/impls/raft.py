@@ -104,6 +104,12 @@ class _WindowConv1x1(nn.Module):
     pair pads to (16, 128) tiles: 25x memory inflation, ~30 ms/step
     profiled at the bench config). Flat tensors still work (shared zoo
     callers pass them), so checkpoints are interchangeable.
+
+    List items may mix two forms (the raft/fs hybrid dispatch produces
+    both): rank-5 (B, H, W, K_dy, K_dx) window tensors, and rank-4
+    already-flat (B, H, W, n·K²) chunks in the dx-major flat channel
+    order (the windowed kernel's native output — contracted directly, no
+    reshape/transpose/concat copies).
     """
 
     features: int
@@ -113,7 +119,9 @@ class _WindowConv1x1(nn.Module):
     def __call__(self, x):
         levels = x if isinstance(x, (list, tuple)) else None
         if levels is not None:
-            in_features = sum(l.shape[-2] * l.shape[-1] for l in levels)
+            in_features = sum(
+                l.shape[-1] if l.ndim == 4 else l.shape[-2] * l.shape[-1]
+                for l in levels)
             pdtype = levels[0].dtype
         else:
             in_features = x.shape[-1]
@@ -134,6 +142,16 @@ class _WindowConv1x1(nn.Module):
             y = 0.0
             offset = 0
             for lvl in levels:
+                if lvl.ndim == 4:
+                    # flat chunk, channels already in the dx-major flat
+                    # contract order: plain slice of the kernel matrix
+                    n = lvl.shape[-1]
+                    y = y + jnp.einsum(
+                        "bhwc,cf->bhwf", lvl.astype(dt),
+                        k2[offset : offset + n],
+                        preferred_element_type=jnp.float32)
+                    offset += n
+                    continue
                 # level windows are (dy, dx)-ordered; the kernel slice is
                 # dx-major (the flat-tensor channel contract), so reshape
                 # it (dx, dy, f) and contract both axes crosswise

@@ -17,7 +17,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from ...ops.corr import correlation_pyramid_direct, lookup_pyramid_levels
+from ...ops.corr import correlation_volume, lookup_pyramid_levels
 from ...ops.pallas import windowed_corr_pyramid
 from ...ops.pool import avg_pool2d
 from ...ops.upsample import interpolate_bilinear
@@ -28,16 +28,54 @@ from ..model import Model, ModelAdapter
 from .raft import BasicUpdateBlock, RaftAdapter, Up8Network
 
 
+def volume_level_split(coarse_shape, corr_levels, itemsize, budget_gib=None):
+    """Greedy per-level dispatch decision: how many fine levels stay on
+    the windowed kernel.
+
+    Walks the pyramid from the coarsest level (each volume is 4x the
+    next coarser one) and moves levels onto materialized volumes while
+    twice their running total — the 2x charges the backward's
+    volume-gradient accumulation — fits the ``RMD_FS_VOLUME_GIB`` budget
+    (default 4 GiB; 0 forces the windowed path everywhere). Returns
+    ``n_windowed``: levels ``[0, n_windowed)`` are computed on the fly.
+    """
+    import os
+
+    if budget_gib is None:
+        budget_gib = float(os.environ.get("RMD_FS_VOLUME_GIB", "4.0"))
+    budget = budget_gib * 2 ** 30
+
+    b0, hc0, wc0 = coarse_shape
+    vol_bytes = [
+        b0 * hc0 * wc0 * (hc0 // 2 ** l) * (wc0 // 2 ** l) * itemsize
+        for l in range(corr_levels)
+    ]
+    n_windowed = corr_levels
+    total = 0
+    for l in reversed(range(corr_levels)):
+        if 2 * (total + vol_bytes[l]) > budget:
+            break
+        total += vol_bytes[l]
+        n_windowed = l
+    return n_windowed
+
+
 class _FsStep(nn.Module):
-    """One GRU iteration — nn.scan body; carry is (hidden, coords1)."""
+    """One GRU iteration — nn.scan body; carry is (hidden, coords1).
+
+    ``n_windowed`` is the per-level dispatch split: pyramid levels
+    ``[0, n_windowed)`` are computed on the fly by the windowed kernel
+    (their volumes don't fit the budget), levels ``[n_windowed, L)`` are
+    looked up from materialized volumes. The broadcast ``pyramid`` input
+    carries pooled f2 maps for the windowed prefix followed by volumes
+    for the coarse suffix.
+    """
 
     corr_levels: int
     corr_radius: int
     recurrent_channels: int
-    upnet: bool
     mask_costs: Tuple[int, ...]
-    full_shape: Tuple[int, int]
-    volume: bool = False
+    n_windowed: int = 0
     dtype: Any = None
 
     @nn.compact
@@ -46,7 +84,8 @@ class _FsStep(nn.Module):
         coords1 = jax.lax.stop_gradient(coords1)
         flow = coords1 - coords0
 
-        if self.volume:
+        n_win = self.n_windowed
+        if n_win == 0:
             # small-enough shapes: ``pyramid`` is the materialized volume
             # pyramid, amortized across iterations — same math (pooling
             # commutes with the dot product), ~4x the throughput of the
@@ -54,7 +93,7 @@ class _FsStep(nn.Module):
             corr = lookup_pyramid_levels(pyramid, coords1,
                                          self.corr_radius,
                                          mask_costs=self.mask_costs)
-        else:
+        elif n_win == self.corr_levels:
             # on-the-fly windowed dot-product against the pooled feature
             # pyramid — the fused kernel (ops/pallas.py) on TPU,
             # per-level windowed correlation off it; O(B·H·W·C) memory at
@@ -64,6 +103,33 @@ class _FsStep(nn.Module):
                 fmap1, pyramid, coords1, self.corr_radius,
                 mask_costs=self.mask_costs, normalize=False,
             )
+        else:
+            # hybrid: the fine levels' volumes don't fit but the coarse
+            # suffix's do (each level is 4x smaller than the last) —
+            # kernel for the prefix, volume lookups for the suffix. The
+            # mixed list goes straight to the motion encoder's
+            # _WindowConv1x1: the kernel's flat (level, dx, dy) chunk
+            # contracts as-is and the volume levels contract in their
+            # native (dy, dx) window form — no concat, no transposes.
+            corr_win = windowed_corr_pyramid(
+                fmap1, pyramid[:n_win], coords1, self.corr_radius,
+                mask_costs=self.mask_costs, normalize=False,
+            )
+            corr = [corr_win] + lookup_pyramid_levels(
+                pyramid[n_win:], coords1, self.corr_radius,
+                mask_costs=self.mask_costs, first_level=n_win,
+            )
+
+        # named so the remat policy saves the correlation output: without
+        # it the windowed Pallas kernel's forward runs a second time in
+        # the backward pass (profiled ~90 ms/step at 1080p), and the
+        # volume-lookup einsums recompute likewise
+        from jax.ad_checkpoint import checkpoint_name
+
+        if isinstance(corr, list):
+            corr = [checkpoint_name(lvl, "corr_features") for lvl in corr]
+        else:
+            corr = checkpoint_name(corr, "corr_features")
 
         h, d = BasicUpdateBlock(self.recurrent_channels, dtype=self.dtype)(
             h, x, corr, flow)
@@ -71,13 +137,7 @@ class _FsStep(nn.Module):
         coords1 = coords1 + d
         flow = coords1 - coords0
 
-        flow_up_net = Up8Network(dtype=self.dtype)(h, flow)
-        if self.upnet:
-            flow_up = flow_up_net
-        else:
-            flow_up = 8.0 * interpolate_bilinear(flow, self.full_shape)
-
-        return (h, coords1), flow_up
+        return (h, coords1), (flow, h)
 
 
 class RaftFsModule(nn.Module):
@@ -119,32 +179,33 @@ class RaftFsModule(nn.Module):
         # f32 inside the kernel)
 
         # strategy dispatch: the windowed computation exists so the
-        # O(H²W²) volume never has to — but where the volume DOES fit,
-        # materializing it once and looking it up per iteration is ~4x
-        # faster at training crops (the windowed kernel is gather-bound).
-        # Identical math either way (pooling/bilinear commute with the
-        # dot product); the estimate charges 2x for the backward's
+        # O(H²W²) volume never has to — but where a level's volume DOES
+        # fit, materializing it once and looking it up per iteration is
+        # ~4x faster (the windowed kernel is gather-bound). Identical
+        # math either way (pooling/bilinear commute with the dot
+        # product). The decision is PER LEVEL, greedy from the coarsest:
+        # each level's volume is 4x smaller than the previous, so at
+        # 1080p the coarse suffix (levels 1-3, ~1.2 GB) fits while
+        # level 0 (3.7 GB) cannot — moving 3 of 4 levels off the
+        # serialized kernel. The estimate charges 2x for the backward's
         # volume-gradient accumulation. RMD_FS_VOLUME_GIB tunes the
         # budget (0 forces the windowed path everywhere).
-        import os
-
         b0, hc0, wc0, _ = fmap1.shape
         itemsize = 2 if dt is not None else 4
-        vol_bytes = sum(
-            b0 * hc0 * wc0 * (hc0 // 2 ** l) * (wc0 // 2 ** l) * itemsize
-            for l in range(self.corr_levels)
-        )
-        budget = float(os.environ.get("RMD_FS_VOLUME_GIB", "2.0")) * 2 ** 30
-        use_volume = 2 * vol_bytes <= budget
+        n_windowed = volume_level_split(
+            (b0, hc0, wc0), self.corr_levels, itemsize)
 
-        if use_volume:
-            pyramid = correlation_pyramid_direct(
-                fmap1, fmap2, self.corr_levels, dtype=dt, normalize=False)
-        else:
-            # avg-pooled second-frame feature pyramid (raft_fs.py:26-31)
-            pyramid = [fmap2]
-            for _ in range(1, self.corr_levels):
-                pyramid.append(avg_pool2d(pyramid[-1], 2))
+        # avg-pooled second-frame feature pyramid (raft_fs.py:26-31);
+        # the coarse suffix becomes materialized volumes against the
+        # same pooled maps (so both dispatch paths correlate against
+        # bit-identical f2 levels)
+        f2_pyramid = [fmap2]
+        for _ in range(1, self.corr_levels):
+            f2_pyramid.append(avg_pool2d(f2_pyramid[-1], 2))
+        pyramid = f2_pyramid[:n_windowed] + [
+            correlation_volume(fmap1, f2, dtype=dt, normalize=False)
+            for f2 in f2_pyramid[n_windowed:]
+        ]
 
         ctx = cnet(img1, train, frozen_bn)
         h = jnp.tanh(ctx[..., :hdim])
@@ -154,7 +215,18 @@ class RaftFsModule(nn.Module):
         coords0 = coordinate_grid(b, hc, wc)
         coords1 = coords0 + flow_init if flow_init is not None else coords0
 
-        body = nn.remat(_FsStep, prevent_cse=False) if self.remat else _FsStep
+        # same remat policy as raft/baseline: save the correlation lookup
+        # outputs (recomputing the windowed kernel / lookup einsums in the
+        # backward costs far more than the per-iteration (B, H/8, W/8,
+        # L·(2r+1)²) buffers) and the GRU x-half gate convs
+        if self.remat:
+            body = nn.remat(
+                _FsStep, prevent_cse=False,
+                policy=jax.checkpoint_policies.save_only_these_names(
+                    "corr_features", "gru_gate_x"),
+            )
+        else:
+            body = _FsStep
         step = nn.scan(
             body,
             variable_broadcast="params",
@@ -166,15 +238,30 @@ class RaftFsModule(nn.Module):
             corr_levels=self.corr_levels,
             corr_radius=self.corr_radius,
             recurrent_channels=hdim,
-            upnet=upnet,
             mask_costs=tuple(mask_costs),
-            full_shape=(img1.shape[1], img1.shape[2]),
-            volume=use_volume,
+            n_windowed=n_windowed,
             dtype=dt,
         )
 
-        (h, coords1), flows_up = step((h, coords1), fmap1, tuple(pyramid), x,
-                                      coords0)
+        (h, coords1), (flows, hiddens) = step((h, coords1), fmap1,
+                                              tuple(pyramid), x, coords0)
+
+        # convex 8x upsampling hoisted out of the remat'd scan and batched
+        # over all iterations, exactly like raft/baseline (raft.py): inside
+        # the scan its full-resolution intermediates are rematerialized
+        # per iteration in the backward pass — the step's largest cost at
+        # high resolution. Explicit name keeps a stable param path.
+        full_shape = (img1.shape[1], img1.shape[2])
+        flows_flat = flows.reshape(iterations * b, hc, wc, 2)
+        hiddens_flat = hiddens.reshape(iterations * b, hc, wc, hdim)
+
+        up_net = nn.remat(Up8Network, prevent_cse=False)(
+            dtype=dt, name="Up8Network_0")(hiddens_flat, flows_flat)
+        if upnet:
+            flows_up = up_net
+        else:
+            flows_up = 8.0 * interpolate_bilinear(flows_flat, full_shape)
+        flows_up = flows_up.reshape(iterations, b, *full_shape, 2)
 
         return [flows_up[i] for i in range(iterations)]
 

@@ -94,6 +94,23 @@ def correlation_pyramid_direct(fmap1, fmap2, num_levels=4, dtype=None,
     return pyramid
 
 
+def correlation_volume(fmap1, fmap2_level, dtype=None, normalize=True):
+    """Single-level all-pairs volume: (B, H1, W1, H2, W2) against one
+    (possibly pooled) frame-2 map.
+
+    The per-level building block of ``correlation_pyramid_direct`` — the
+    hybrid per-level dispatch (raft/fs) materializes volumes for only the
+    coarse pyramid levels whose O(H1·W1·H2·W2) cost fits the budget.
+    Accumulates in float32 on the MXU; ``dtype`` casts the result.
+    """
+    c = fmap1.shape[-1]
+    corr = jnp.einsum("bijc,bklc->bijkl", fmap1, fmap2_level,
+                      preferred_element_type=jnp.float32)
+    if normalize:
+        corr = corr / jnp.sqrt(jnp.asarray(c, jnp.float32))
+    return corr.astype(dtype) if dtype is not None else corr
+
+
 def window_offsets(radius, dtype=jnp.float32):
     """(2r+1,) per-axis window offsets: -r, ..., 0, ..., r.
 
@@ -168,7 +185,8 @@ def _lookup_level(corr, x, y):
                       preferred_element_type=jnp.float32)
 
 
-def lookup_pyramid_levels(pyramid, coords, radius, mask_costs=()):
+def lookup_pyramid_levels(pyramid, coords, radius, mask_costs=(),
+                          first_level=0):
     """Windowed lookup, one (B, H, W, K_dy, K_dx) tensor per pyramid level.
 
     The un-flattened variant of ``lookup_pyramid``: consumers that contract
@@ -177,23 +195,29 @@ def lookup_pyramid_levels(pyramid, coords, radius, mask_costs=()):
     to K² and concatenating levels forces XLA layout copies of
     (8,128)-tile-padded windows, profiled at ~30 ms/step at the bench
     config.
+
+    ``first_level`` offsets the pyramid: ``pyramid[i]`` is treated as
+    octave ``first_level + i`` for center scaling and ``mask_costs`` ids —
+    the hybrid per-level dispatch (raft/fs) looks up only the coarse
+    suffix of the pyramid through volumes.
     """
     d = window_offsets(radius, coords.dtype)
 
     out = []
     for i, corr in enumerate(pyramid):
-        centers = coords / (2**i)
+        lvl = first_level + i
+        centers = coords / (2**lvl)
         x = centers[..., 0:1] + d  # (B, H, W, K) window positions along W2
         y = centers[..., 1:2] + d  # (B, H, W, K) window positions along H2
         level = _lookup_level(corr, x, y)  # (..., K_dy, K_dx)
-        if i + 3 in mask_costs:
+        if lvl + 3 in mask_costs:
             level = jnp.zeros_like(level)
         out.append(level)
 
     return out
 
 
-def lookup_pyramid(pyramid, coords, radius, mask_costs=()):
+def lookup_pyramid(pyramid, coords, radius, mask_costs=(), first_level=0):
     """Windowed lookup over all pyramid levels (reference raft.py:49-95).
 
     coords: (B, H, W, 2) level-0 target-pixel positions. Returns
@@ -202,7 +226,8 @@ def lookup_pyramid(pyramid, coords, radius, mask_costs=()):
     downsampling octave), matching the reference's convention (raft.py:86).
     """
     k = 2 * radius + 1
-    levels = lookup_pyramid_levels(pyramid, coords, radius, mask_costs)
+    levels = lookup_pyramid_levels(pyramid, coords, radius, mask_costs,
+                                   first_level)
     # levels are (dy, dx)-ordered; the flat channel contract is dx-major
     return jnp.concatenate(
         [lvl.transpose(0, 1, 2, 4, 3).reshape(*coords.shape[:3], k * k)

@@ -74,9 +74,34 @@ def test_raft_fs_forward(volume_gib, monkeypatch):
     assert models.config.load_model(cfg).get_config() == cfg
 
 
-def test_raft_fs_volume_dispatch_matches_windowed(monkeypatch):
-    """The two correlation strategies compute the same model function
-    (pooling/bilinear interpolation commute with the dot product)."""
+def test_raft_fs_volume_level_split():
+    """The greedy per-level dispatch moves coarse levels onto volumes
+    one at a time as the budget grows (shape: the toy test config's
+    8x12 f32 coarse grid — per-level volumes 36864/9216/2304 bytes)."""
+    from raft_meets_dicl_tpu.models.impls.raft_fs import volume_level_split
+
+    split = lambda gib: volume_level_split((1, 8, 12), 3, 4, budget_gib=gib)
+    assert split(0.0) == 3        # nothing fits: pure windowed
+    assert split(1e-5) == 2       # level 2 only
+    assert split(5e-5) == 1       # levels 1-2
+    assert split(2.0) == 0        # everything: pure volume
+    # the 2x backward charge: a budget of exactly 2x the coarsest level
+    # admits it, one byte less does not
+    assert volume_level_split((1, 8, 12), 3, 4, budget_gib=4608 / 2**30) == 2
+    assert volume_level_split((1, 8, 12), 3, 4, budget_gib=4607 / 2**30) == 3
+
+
+@pytest.mark.parametrize("volume_gib,n_windowed", [
+    ("2.0", 0),   # every level fits: pure materialized-volume path
+    ("5e-5", 1),  # levels 1-2 fit: hybrid, kernel level 0 + volumes 1-2
+    ("1e-5", 2),  # level 2 fits: hybrid, kernel levels 0-1 + volume 2
+])
+def test_raft_fs_volume_dispatch_matches_windowed(volume_gib, n_windowed,
+                                                  monkeypatch):
+    """Every dispatch split computes the same model function as the pure
+    windowed path (pooling/bilinear interpolation commute with the dot
+    product); the per-level greedy budget moves coarse levels onto
+    materialized volumes one at a time."""
     cfg = {
         "type": "raft/fs",
         "parameters": {"corr-levels": 3, "corr-radius": 2, "corr-channels": 16,
@@ -84,7 +109,13 @@ def test_raft_fs_volume_dispatch_matches_windowed(monkeypatch):
     }
     img = _img()
 
-    monkeypatch.setenv("RMD_FS_VOLUME_GIB", "2.0")
+    # the budget must produce the split this case claims to exercise
+    from raft_meets_dicl_tpu.models.impls.raft_fs import volume_level_split
+
+    assert volume_level_split((1, 8, 12), 3, 4,
+                              budget_gib=float(volume_gib)) == n_windowed
+
+    monkeypatch.setenv("RMD_FS_VOLUME_GIB", volume_gib)
     m_vol = models.config.load_model(cfg)
     v = m_vol.init(RNG, img, img, iterations=1)
     out_vol = m_vol.apply(v, img, img, iterations=3)
