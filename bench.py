@@ -26,11 +26,15 @@ BASELINE_PAIRS_PER_SEC_PER_CHIP = 400.0 / 32.0
 
 def _measure(model_cfg, loss_cfg, batch, height, width, model_args, steps):
     """One synthetic training-step throughput measurement; all device
-    state is local, so buffers free when it returns."""
+    state is local, so buffers free when it returns.
+
+    Returns (pairs_per_sec, peak_bytes, telemetry_summary) — the summary
+    carries compile/cache counts from the active telemetry sink plus
+    dispatch-time stats, so BENCH_*.json records more than one number."""
     import optax
 
     import raft_meets_dicl_tpu.models as models
-    from raft_meets_dicl_tpu import parallel
+    from raft_meets_dicl_tpu import parallel, telemetry
 
     spec = models.load({
         "name": "bench", "id": "bench",
@@ -56,21 +60,56 @@ def _measure(model_cfg, loss_cfg, batch, height, width, model_args, steps):
     state = parallel.TrainState.create(variables, tx)
     step = parallel.make_train_step(model, loss, tx, model_args=model_args)
 
+    tele = telemetry.get()
+    tail0 = len(getattr(tele, "events", ()))
+
     # warmup / compile; sync by fetching the scalar — on the tunneled axon
     # backend block_until_ready does not reliably wait, value transfer does
+    t0 = time.perf_counter()
     state, aux = step(state, img1, img2, flow, valid)
     float(aux["loss"])
+    compile_wall = time.perf_counter() - t0
 
+    # per-step dispatch timing only when telemetry is on: RMD_TELEMETRY=0
+    # must restore the bare measurement loop
+    dispatch = []
     t0 = time.perf_counter()
-    for _ in range(steps):
-        state, aux = step(state, img1, img2, flow, valid)
+    if tele.enabled:
+        for _ in range(steps):
+            ts = time.perf_counter()
+            state, aux = step(state, img1, img2, flow, valid)
+            dispatch.append(time.perf_counter() - ts)
+    else:
+        for _ in range(steps):
+            state, aux = step(state, img1, img2, flow, valid)
     float(aux["loss"])
     dt = time.perf_counter() - t0
+
+    summary = None
+    if tele.enabled:
+        # the bench sink is memory-only: the tail since tail0 is exactly
+        # this measurement's compile/cache activity
+        tail = getattr(tele, "events", [])[tail0:]
+        compiles = [e for e in tail if e["kind"] == "compile"]
+        caches = [e for e in tail if e["kind"] == "cache"]
+        dispatch.sort()
+        summary = {
+            "compiles": len(compiles),
+            "compile_s": round(sum(e["seconds"] for e in compiles), 3),
+            "cache_hits": sum(1 for e in caches if e["event"] == "hit"),
+            "cache_misses": sum(1 for e in caches if e["event"] == "miss"),
+            "warmup_wall_s": round(compile_wall, 3),
+            "step_ms_mean": round(dt / steps * 1e3, 3),
+            "dispatch_ms_mean": round(sum(dispatch) / steps * 1e3, 3),
+            "dispatch_ms_p95": round(
+                dispatch[min(steps - 1, int(round(0.95 * (steps - 1))))]
+                * 1e3, 3),
+        }
 
     # peak_bytes_in_use is a process-lifetime high-water mark: meaningful
     # for the first measurement in a process, an upper bound afterwards
     stats = jax.local_devices()[0].memory_stats() or {}
-    return batch * steps / dt, stats.get("peak_bytes_in_use", 0)
+    return batch * steps / dt, stats.get("peak_bytes_in_use", 0), summary
 
 
 def main():
@@ -79,6 +118,12 @@ def main():
     # the full run is measurement-dominated (~5 min)
     from raft_meets_dicl_tpu.utils.compcache import enable_persistent_cache
     enable_persistent_cache()
+
+    # memory-only telemetry sink: compile/cache events feed the per-model
+    # summaries attached to the JSON lines (RMD_TELEMETRY=0 disables and
+    # drops the summaries, restoring the bare measurement path)
+    from raft_meets_dicl_tpu import telemetry
+    telemetry.activate(telemetry.create())
 
     batch = int(os.environ.get("BENCH_BATCH", "6"))
     height = int(os.environ.get("BENCH_HEIGHT", "400"))
@@ -96,7 +141,7 @@ def main():
     # - convex Up8 hoisted out of the remat'd scan, compact mask layout,
     #   remat policy saving the corr lookups: 0.43 s
     # - fused Pallas softmax+combine Up8 kernel (ops/pallas.py): 0.39 s
-    pairs_per_sec, _ = _measure(
+    pairs_per_sec, _, tsum = _measure(
         {"type": "raft/baseline", "parameters": {"mixed-precision": True}},
         {"type": "raft/sequence"},
         batch, height, width, {"iterations": iters}, steps,
@@ -108,6 +153,8 @@ def main():
         "unit": "image-pairs/sec/chip",
         "vs_baseline": round(pairs_per_sec / BASELINE_PAIRS_PER_SEC_PER_CHIP, 3),
     }
+    if tsum is not None:
+        result["telemetry"] = tsum
 
     # publish the primary metric immediately: the flagship measurement
     # below adds a cold ~10 min compile, and a harness timeout must not
@@ -123,7 +170,7 @@ def main():
                 fb, fh, fw, fi, fs = 1, 64, 128, (2, 1, 1), 2
             else:
                 fb, fh, fw, fi, fs = 6, 384, 704, (4, 3, 3), 5
-            ctf_pairs, _ = _measure(
+            ctf_pairs, _, ctf_tsum = _measure(
                 {"type": "raft+dicl/ctf-l3",
                  "parameters": {"mixed-precision": True}},
                 {"type": "raft+dicl/mlseq",
@@ -131,6 +178,8 @@ def main():
                 fb, fh, fw, {"iterations": fi}, fs,
             )
             result["ctf_l3_pairs_per_sec"] = round(ctf_pairs, 3)
+            if ctf_tsum is not None:
+                result["ctf_l3_telemetry"] = ctf_tsum
         except Exception as e:  # noqa: BLE001 - report, don't lose the line
             result["ctf_l3_error"] = f"{type(e).__name__}: {str(e)[:120]}"
 
@@ -186,9 +235,11 @@ def main():
                 candidates += fallbacks.get(name, [])
             for (zb, zh, zw, zargs, zsteps), label in candidates:
                 try:
-                    pairs, _ = _measure(model_cfg, loss_cfg, zb, zh, zw,
-                                        zargs, zsteps)
+                    pairs, _, zsum = _measure(model_cfg, loss_cfg, zb, zh, zw,
+                                              zargs, zsteps)
                     result[f"{name}_pairs_per_sec"] = round(pairs, 3)
+                    if zsum is not None:
+                        result[f"{name}_telemetry"] = zsum
                     if label:
                         result[f"{name}_config"] = label
                     result.pop(f"{name}_error", None)

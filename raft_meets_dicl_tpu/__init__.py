@@ -30,6 +30,9 @@ Layout (mirrors the reference's layer map, SURVEY.md §1, redesigned TPU-first):
 - ``evaluation/`` ``metrics/`` ``inspect/`` ``visual/`` — evaluation loop,
                   metric registry, TensorBoard inspection + hooks, flow
                   visualization.
+- ``telemetry/`` — run-wide structured telemetry: span timers, versioned
+                  JSONL event sink (``events.jsonl`` per run), compile /
+                  memory / anomaly events, report rendering.
 - ``cmd/``      — CLI subcommands (train / evaluate / checkpoint / gencfg).
 """
 
@@ -43,6 +46,7 @@ from . import (  # noqa: E402
     ops,
     parallel,
     strategy,
+    telemetry,
     utils,
     visual,
 )
@@ -50,5 +54,5 @@ from . import inspect  # noqa: E402  (module name mirrors the reference)
 
 __all__ = [
     "data", "evaluation", "inspect", "metrics", "models", "ops", "parallel",
-    "strategy", "utils", "visual",
+    "strategy", "telemetry", "utils", "visual",
 ]

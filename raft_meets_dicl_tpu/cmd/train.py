@@ -19,7 +19,7 @@ import re
 from pathlib import Path
 
 from .. import inspect as inspect_
-from .. import models, parallel, strategy, utils
+from .. import models, parallel, strategy, telemetry, utils
 from ..strategy.training import TrainingContext
 
 _DEFAULT_ENV = Path(__file__).parent.parent.parent / "cfg" / "env" / "default.yaml"
@@ -200,6 +200,18 @@ def _train(args):
     logging.info(f"starting: time is {timestamp}, writing to '{path_out}'")
     logging.info(f"description: {args.comment if args.comment else '<not available>'}")
 
+    # telemetry: structured run events (events.jsonl) — primary-only, like
+    # every other run artifact. --no-telemetry / RMD_TELEMETRY=0 disable;
+    # render the sink with scripts/telemetry_report.py afterwards.
+    if getattr(args, "no_telemetry", False) or not primary:
+        tele = telemetry.activate(telemetry.NullTelemetry())
+    else:
+        tele_path = getattr(args, "telemetry", None)
+        tele = telemetry.activate(telemetry.create(
+            Path(tele_path) if tele_path else path_out / "events.jsonl"))
+        if tele.path:
+            logging.info(f"writing telemetry events to '{tele.path}'")
+
     # seeds (apply() seeds host RNGs and yields the root jax key)
     if args.reproduce or args.seeds:
         if cfg_seeds is None:
@@ -313,11 +325,17 @@ def _train(args):
         log.info(f"capturing jax.profiler trace to '{profile_dir}'")
         jax.profiler.start_trace(profile_dir)
 
+    tele.emit("run_start", dir=str(path_out),
+              commit=utils.vcs.get_git_head_hash(),
+              comment=args.comment or "")
+
     try:
         tctx.run(args.start_stage, args.start_epoch, chkpt)
     finally:
         if profile_dir:
             jax.profiler.stop_trace()
+        tele.emit("run_end")
+        tele.close()
 
 
 def train(args):

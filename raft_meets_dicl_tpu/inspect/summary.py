@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .. import metrics, strategy, utils, visual
+from .. import metrics, strategy, telemetry, utils, visual
 from ..strategy.inspector import Inspector
 from .hooks import Hook
 from .writer import SummaryWriter
@@ -268,12 +268,13 @@ class StrategyValidation(Validation):
         model_args = dict(stage.model_args)
         loss_args = dict(stage.loss_args)
 
-        @jax.jit
         def step(variables, img1, img2, flow, valid):
             out = model.apply(variables, img1, img2, train=False, **model_args)
             result = model.get_adapter().wrap_result(out, img1.shape[1:3])
             l = loss_fn(model, result.output(), flow, valid, **loss_args)
             return result.final(), l
+
+        step = telemetry.instrument_jit("val_step", jax.jit(step))
 
         if cacheable:
             self._val_steps[key] = step
@@ -488,7 +489,6 @@ class SummaryInspector(Inspector):
         model = ctx.model
         args = model.arguments | stage.model_args
 
-        @jax.jit
         def fn(variables, img1, img2):
             _, mutated = model.module.apply(
                 variables, img1, img2, train=False,
@@ -496,6 +496,8 @@ class SummaryInspector(Inspector):
                 capture_intermediates=True, mutable=["intermediates"], **args,
             )
             return mutated["intermediates"]
+
+        fn = telemetry.instrument_jit("capture_intermediates", jax.jit(fn))
 
         if args_key is not None:
             self._capture_fns[key] = fn
@@ -574,6 +576,19 @@ class SummaryInspector(Inspector):
             for k, v in m.reduce().items():
                 self.writer.add_scalar(k, v, ctx.step)
             m.reset()
+
+        # mirror the telemetry step record (emitted just before this
+        # callback) into the TB scalars, so phase timings sit next to the
+        # training curves without opening the JSONL
+        ev = telemetry.get().last_step
+        if ev is not None and ev.get("step") == ctx.step:
+            for name, secs in ev["phases"].items():
+                self.writer.add_scalar(f"Telemetry/Phase/{name}",
+                                       secs * 1e3, ctx.step)
+            self.writer.add_scalar("Telemetry/StepTimeMs",
+                                   ev["step_time"] * 1e3, ctx.step)
+            self.writer.add_scalar("Telemetry/StepsPerSecEma",
+                                   ev["throughput_ema"], ctx.step)
 
         due = [v for v in self.val_step
                if ctx.step > 0 and ctx.step % v.frequency == 0]

@@ -83,6 +83,12 @@ def main():
                        help="capture a jax.profiler trace of the run into DIR "
                             "(open with TensorBoard's profile plugin); "
                             "combine with --limit-steps")
+    train.add_argument("--telemetry", metavar="PATH",
+                       help="telemetry JSONL sink path "
+                            "[default: <run-dir>/events.jsonl]")
+    train.add_argument("--no-telemetry", action="store_true",
+                       help="disable run telemetry "
+                            "(equivalent to RMD_TELEMETRY=0)")
 
     # subcommand: evaluate
     eval_ = subp.add_parser("evaluate", aliases=["e", "eval"], formatter_class=fmtcls,

@@ -38,16 +38,25 @@ def volume_level_split(coarse_shape, corr_levels, itemsize, budget_gib=None):
     volume-gradient accumulation — fits the ``RMD_FS_VOLUME_GIB`` budget
     (default 4 GiB; 0 forces the windowed path everywhere). Returns
     ``n_windowed``: levels ``[0, n_windowed)`` are computed on the fly.
+
+    The budget is PER CHIP: under SPMD the trace sees the global batch
+    while each chip holds only its ``1/data_axis_size`` slice of the
+    batch-sharded volume, so the estimate divides by the data-parallel
+    degree published by the step builders (parallel.mesh).
     """
     import os
+
+    from ...parallel.mesh import data_axis_size
 
     if budget_gib is None:
         budget_gib = float(os.environ.get("RMD_FS_VOLUME_GIB", "4.0"))
     budget = budget_gib * 2 ** 30
 
     b0, hc0, wc0 = coarse_shape
+    n_chips = data_axis_size()
     vol_bytes = [
         b0 * hc0 * wc0 * (hc0 // 2 ** l) * (wc0 // 2 ** l) * itemsize
+        // n_chips
         for l in range(corr_levels)
     ]
     n_windowed = corr_levels
@@ -188,8 +197,10 @@ class RaftFsModule(nn.Module):
         # 1080p the coarse suffix (levels 1-3, ~1.2 GB) fits while
         # level 0 (3.7 GB) cannot — moving 3 of 4 levels off the
         # serialized kernel. The estimate charges 2x for the backward's
-        # volume-gradient accumulation. RMD_FS_VOLUME_GIB tunes the
-        # budget (0 forces the windowed path everywhere).
+        # volume-gradient accumulation and is per chip (the global-batch
+        # shapes seen at trace time are divided by the SPMD data-parallel
+        # degree). RMD_FS_VOLUME_GIB tunes the budget (0 forces the
+        # windowed path everywhere).
         b0, hc0, wc0, _ = fmap1.shape
         itemsize = 2 if dt is not None else 4
         n_windowed = volume_level_split(
@@ -250,7 +261,10 @@ class RaftFsModule(nn.Module):
         # over all iterations, exactly like raft/baseline (raft.py): inside
         # the scan its full-resolution intermediates are rematerialized
         # per iteration in the backward pass — the step's largest cost at
-        # high resolution. Explicit name keeps a stable param path.
+        # high resolution. Explicit name keeps a stable param path going
+        # forward; checkpoints from before the hoist (params under the
+        # scan-body subtree) are migrated at load time by
+        # strategy.checkpoint._remap_legacy_model_state.
         full_shape = (img1.shape[1], img1.shape[2])
         flows_flat = flows.reshape(iterations * b, hc, wc, 2)
         hiddens_flat = hiddens.reshape(iterations * b, hc, wc, hdim)

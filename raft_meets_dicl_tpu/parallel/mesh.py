@@ -4,6 +4,29 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# data-parallel degree of the step function currently being built/traced
+# (see set_data_axis_size) — models read this to convert global-batch
+# memory estimates into per-chip ones under SPMD
+_data_axis_size = 1
+
+
+def set_data_axis_size(n):
+    """Record the data-axis device count for subsequent model traces.
+
+    Called by the step builders (``make_train_step``/``make_eval_step``):
+    under SPMD a module traces with the GLOBAL batch, so any HBM budget
+    the trace computes from shapes (e.g. raft/fs's volume dispatch,
+    ``RMD_FS_VOLUME_GIB``) must be scaled by the data-parallel degree to
+    describe one chip. 1 = unsharded.
+    """
+    global _data_axis_size
+    _data_axis_size = max(1, int(n))
+
+
+def data_axis_size():
+    """Data-parallel degree the current trace should assume (>= 1)."""
+    return _data_axis_size
+
 
 def data_mesh(n_devices=None, axis_name="data", devices=None):
     """1-D mesh over ``n_devices`` (default: all) for data parallelism."""

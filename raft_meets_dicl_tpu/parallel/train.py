@@ -17,6 +17,29 @@ import optax
 from flax import struct
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..telemetry import instrument_jit
+from .mesh import set_data_axis_size
+
+
+def _with_data_axis(n, fn):
+    """Scope the published data-parallel degree to ``fn``'s calls.
+
+    The model traces inside the first call of the jitted function, so the
+    degree must be pinned around the call, not at build time — otherwise
+    an interleaved unsharded trace (e.g. the inspector's process-local
+    validation jit) would read a stale value. Resets to 1 on exit so
+    unsharded traces always see the unsharded degree.
+    """
+
+    def wrapped(*args, **kwargs):
+        set_data_axis_size(n)
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            set_data_axis_size(1)
+
+    return wrapped
+
 
 class TrainState(struct.PyTreeNode):
     """Everything the train step carries: params, BN stats, optimizer."""
@@ -109,8 +132,12 @@ def make_train_step(model, loss_fn, tx, mesh=None, loss_args=None,
 
         n_lead = 1
 
+    # instrument_jit: a passthrough label wrapper so telemetry attributes
+    # this function's (re)compiles to 'train_step' in compile events
     if mesh is None:
-        return jax.jit(public, donate_argnums=(0,) if donate else ())
+        return instrument_jit(
+            "train_step",
+            jax.jit(public, donate_argnums=(0,) if donate else ()))
 
     repl = NamedSharding(mesh, P())
     data = NamedSharding(mesh, P("data"))
@@ -119,12 +146,14 @@ def make_train_step(model, loss_fn, tx, mesh=None, loss_args=None,
         aux_shardings["grads"] = repl
 
     in_shardings = (repl,) + (None,) * (n_lead - 1) + (data,) * 4
-    return jax.jit(
-        public,
-        in_shardings=in_shardings,
-        out_shardings=(repl, aux_shardings),
-        donate_argnums=(0,) if donate else (),
-    )
+    return instrument_jit("train_step", _with_data_axis(
+        mesh.devices.size,
+        jax.jit(
+            public,
+            in_shardings=in_shardings,
+            out_shardings=(repl, aux_shardings),
+            donate_argnums=(0,) if donate else (),
+        )))
 
 
 def make_eval_step(model, mesh=None, model_args=None):
@@ -137,8 +166,10 @@ def make_eval_step(model, mesh=None, model_args=None):
         return result.final()
 
     if mesh is None:
-        return jax.jit(step)
+        return instrument_jit("eval_step", jax.jit(step))
 
     repl = NamedSharding(mesh, P())
     data = NamedSharding(mesh, P("data"))
-    return jax.jit(step, in_shardings=(repl, data, data), out_shardings=data)
+    return instrument_jit("eval_step", _with_data_axis(
+        mesh.devices.size,
+        jax.jit(step, in_shardings=(repl, data, data), out_shardings=data)))

@@ -70,6 +70,42 @@ class State:
         }
 
 
+def _remap_legacy_model_state(target, state):
+    """Migrate pre-round-5 ``raft/fs`` checkpoints at load time.
+
+    Round 5 hoisted ``Up8Network`` out of the GRU scan body
+    (models/impls/raft_fs.py), moving its params from the scanned step
+    subtree (``ScanCheckpoint_FsStep_0``, or ``Scan_FsStep_0`` with
+    ``remat: false``) to top-level ``Up8Network_0``. Old checkpoints keep
+    the scan-body layout and would fail ``from_state_dict`` against the
+    new structure. The rule fires only when the structures prove the
+    migration applies: the restore *target* expects a top-level
+    ``Up8Network_0`` the stored state lacks, and the stored scan body has
+    one to give. Everything else passes through untouched.
+    """
+    from collections.abc import Mapping
+
+    if not isinstance(target, Mapping) or not isinstance(state, Mapping):
+        return state
+    params_t = target.get("params")
+    params_s = state.get("params")
+    if not isinstance(params_t, Mapping) or not isinstance(params_s, Mapping):
+        return state
+    if "Up8Network_0" not in params_t or "Up8Network_0" in params_s:
+        return state
+
+    for scan_body in ("ScanCheckpoint_FsStep_0", "Scan_FsStep_0"):
+        if (isinstance(params_s.get(scan_body), Mapping)
+                and "Up8Network_0" in params_s[scan_body]):
+            body = dict(params_s[scan_body])
+            params_s = dict(params_s)
+            params_s["Up8Network_0"] = body.pop("Up8Network_0")
+            params_s[scan_body] = body
+            return dict(state) | {"params": params_s}
+
+    return state
+
+
 def _to_host(tree):
     """Device arrays → numpy for serialization.
 
@@ -158,7 +194,8 @@ class Checkpoint:
         out_vars, out_opt, out_scaler = variables, opt_state, scaler
 
         if variables is not None:
-            out_vars = serialization.from_state_dict(variables, self.state.model)
+            model_state = _remap_legacy_model_state(variables, self.state.model)
+            out_vars = serialization.from_state_dict(variables, model_state)
         if opt_state is not None:
             out_opt = serialization.from_state_dict(opt_state, self.state.optimizer)
         if scaler is not None:
@@ -307,6 +344,14 @@ class CheckpointManager:
 
         log.debug(f"saving checkpoint to '{entry.path}'")
 
+        import time
+
+        from .. import telemetry
+
+        # timed from state assembly: the device->host fetch of the full
+        # param/opt tree, not just the file write, is the step stall a
+        # checkpoint causes
+        t0 = time.perf_counter()
         chkpt = Checkpoint(
             model=self.model_id,
             iteration=Iteration(stage.index, epoch, step),
@@ -323,7 +368,12 @@ class CheckpointManager:
                 "source": "training",
             },
         )
+
         chkpt.save(entry.path)
+        telemetry.get().emit(
+            "checkpoint", path=str(entry.path), step=step,
+            seconds=round(time.perf_counter() - t0, 4),
+        )
 
         self.checkpoints.append(entry)
         self.trim(n_best=self.keep_best, n_latest=self.keep_latest)

@@ -14,6 +14,7 @@ clipping live inside it as optax transforms. Per-instance host work is just
 the scheduler tick, callbacks, and a scalar fetch (loss + finiteness).
 """
 
+import time
 from datetime import datetime
 from pathlib import Path
 from typing import Optional
@@ -21,13 +22,13 @@ from typing import Optional
 import jax
 import numpy as np
 
-from .. import utils
+from .. import telemetry, utils
 from ..parallel import TrainState, make_train_step, replicate, shard_batch
 from .checkpoint import Checkpoint, Iteration, State
 from .spec import Stage, Strategy
 
 
-def _device_prefetch(samples, put, depth=2):
+def _device_prefetch(samples, put, depth=2, tele=None):
     """Pipeline host batches onto the device ahead of consumption.
 
     On a remote/tunneled backend the per-step host->device input
@@ -37,18 +38,28 @@ def _device_prefetch(samples, put, depth=2):
     loop receives (host_batch, device_batch, meta) with transfers
     already in flight. Loader exceptions re-raise at the consumption
     point.
+
+    ``tele`` gets two phase streams: ``device_put`` (the worker's
+    transfer-initiation time, attributed up to ``depth`` batches ahead of
+    the consuming step — the aggregate breakdown is what matters) and
+    ``data_wait`` (time the consumer blocks on the queue, i.e. the input
+    pipeline failing to keep ahead of the device).
     """
     import queue
     import threading
 
     q = queue.Queue(maxsize=depth)
     _END = object()
+    tele = tele if tele is not None else telemetry.get()
 
     def worker():
         try:
             for img1, img2, flow, valid, meta in samples:
                 host = (img1, img2, flow, valid)
-                q.put((host, put(host), meta))
+                t0 = time.perf_counter()
+                dev = put(host)
+                tele.add_phase("device_put", time.perf_counter() - t0)
+                q.put((host, dev, meta))
         except BaseException as e:  # noqa: BLE001 - re-raised by consumer
             q.put((_END, e, None))
             return
@@ -57,7 +68,9 @@ def _device_prefetch(samples, put, depth=2):
     t = threading.Thread(target=worker, daemon=True)
     t.start()
     while True:
+        t0 = time.perf_counter()
         host, dev, meta = q.get()
+        tele.add_phase("data_wait", time.perf_counter() - t0)
         if host is _END:
             if dev is not None:
                 raise dev
@@ -361,7 +374,20 @@ class TrainingContext:
         self._finite_every = max(
             1, int(os.environ.get("RMD_FINITE_CHECK_EVERY", "10")))
 
+        # device-sync sampling bookkeeping: device step time is measured
+        # at the finite-fetch cadence (the fetch is already a pipeline
+        # drain), never per step — a per-step sync is the serialization
+        # round 5 removed
+        self._dispatched = 0
+        self._last_sync_dispatched = 0
+        self._last_sync_t = time.perf_counter()
+
         self.inspector.on_stage_start(log, self, stage)
+        telemetry.get().emit(
+            "stage_start", stage=stage.index, step=self.step,
+            id=stage.id, name=stage.name, epochs=stage.data.epochs,
+            batch_size=stage.data.batch_size,
+        )
 
         log.info(f"running {stage.data.epochs} epochs")
         for epoch in range(start_epoch, stage.data.epochs):
@@ -380,9 +406,13 @@ class TrainingContext:
         self.variables = self.train_variables()
 
         self.inspector.on_stage(log, self, stage)
+        telemetry.get().emit("stage_end", stage=stage.index, step=self.step)
 
     def run_epoch(self, log, stage, epoch):
         self.current_epoch = epoch
+        tele = telemetry.get()
+        tele.emit("epoch_start", stage=stage.index, epoch=epoch,
+                  step=self.step)
 
         desc = (
             f"stage {stage.index + 1}/{len(self.strategy.stages)}, "
@@ -422,7 +452,7 @@ class TrainingContext:
             put = base_put
 
         for i, (host, dev, meta) in enumerate(
-                _device_prefetch(samples, put)):
+                _device_prefetch(samples, put, tele=tele)):
             log_ = log.new(f"step {self.step}", sep=", ")
             self.log = log_
 
@@ -434,19 +464,23 @@ class TrainingContext:
         self.log = log
         self._flush_finite_check(log)
 
-        if _os.environ.get("RMD_DEBUG_MEM"):
-            rss = 0.0
-            with open("/proc/self/status") as f:
-                for line in f:
-                    if line.startswith("VmRSS:"):
-                        rss = int(line.split()[1]) / 2**20
-            live = len(jax.live_arrays())
-            log.info(f"mem: rss {rss:.2f} GiB, live jax arrays {live}")
+        # memory watermarks: RMD_DEBUG_MEM's ad-hoc print, promoted to a
+        # structured per-epoch event (snapshot cost is one procfs read +
+        # a live-array census — epoch-boundary cheap)
+        if tele.enabled or _os.environ.get("RMD_DEBUG_MEM"):
+            snap = telemetry.memory_snapshot()
+            tele.emit("memory", stage=stage.index, epoch=epoch,
+                      step=self.step, **snap)
+            if _os.environ.get("RMD_DEBUG_MEM"):
+                log.info(f"mem: rss {snap['host_rss_gib']:.2f} GiB, "
+                         f"live jax arrays {snap['live_arrays']}")
 
         for s in self.lr_sched_epoch:
             s.step()
 
         self.inspector.on_epoch(log, self, stage, epoch)
+        tele.emit("epoch_end", stage=stage.index, epoch=epoch,
+                  step=self.step)
 
     def _flush_finite_check(self, log):
         """Resolve the deferred finite flag of the epoch's last step
@@ -484,7 +518,10 @@ class TrainingContext:
         self.inspector.on_batch_start(log, self, stage, epoch, i, img1, img2,
                                       flow, valid, meta)
 
-        self.state, aux = self.step_fn(self.state, lr, *dev)
+        tele = telemetry.get()
+        with tele.span("dispatch"):
+            self.state, aux = self.step_fn(self.state, lr, *dev)
+        self._dispatched += 1
 
         # validate output, check for non-finite numbers — DEFERRED and
         # AMORTIZED: bool(finite) is a device->host fetch, and fetching
@@ -501,12 +538,22 @@ class TrainingContext:
             self._pending_finite = (aux["finite"], stage, epoch)
             if (i + 1) % self._finite_every == 0:
                 prev, self._pending_finite = self._pending_finite, None
-                if not bool(prev[0]):
+                t0 = time.perf_counter()
+                finite = bool(prev[0])
+                self._emit_device_sync(tele, time.perf_counter() - t0)
+                if not finite:
                     self._dump_failed(log, prev[1], prev[2])
                     raise RuntimeError(
                         "non-finite flow values detected (flagged on a "
                         "later step than the producing one; the state "
                         "dump includes the poisoned updates)")
+        elif tele.enabled and (i + 1) % self._finite_every == 0:
+            # validation disabled: the finite fetch (our usual free sync
+            # point) never happens, so sample the pipeline drain
+            # explicitly at the same amortized cadence
+            t0 = time.perf_counter()
+            jax.block_until_ready(aux["loss"])
+            self._emit_device_sync(tele, time.perf_counter() - t0)
 
         loss = aux["loss"]
 
@@ -514,16 +561,17 @@ class TrainingContext:
         # host-side metrics compare against this process's local targets —
         # reassemble the local slice from the addressable shards (ordered
         # by their global offset; each process owns one contiguous stripe)
-        if self.mesh is not None and jax.process_count() > 1:
-            shards = sorted(aux["final"].addressable_shards,
-                            key=lambda s: s.index[0].start or 0)
-            aux = aux | {"final": np.concatenate(
-                [np.asarray(s.data) for s in shards])}
+        with tele.span("host"):
+            if self.mesh is not None and jax.process_count() > 1:
+                shards = sorted(aux["final"].addressable_shards,
+                                key=lambda s: s.index[0].start or 0)
+                aux = aux | {"final": np.concatenate(
+                    [np.asarray(s.data) for s in shards])}
 
-        result = _StepResult(aux)
+            result = _StepResult(aux)
 
-        self.inspector.on_batch(log, self, stage, epoch, i, img1, img2, flow,
-                                valid, meta, result, loss)
+            self.inspector.on_batch(log, self, stage, epoch, i, img1, img2,
+                                    flow, valid, meta, result, loss)
 
         self._accum += 1
         if self._accum % accumulate == 0:
@@ -532,12 +580,35 @@ class TrainingContext:
             for s in self.lr_sched_inst:
                 s.step()
 
+            # step event precedes on_step_end so the inspector can mirror
+            # this step's phases to the TB scalars under the same step
+            tele.step_event(self.step, stage=stage.index, epoch=epoch,
+                            batch=stage.data.batch_size)
             self.inspector.on_step_end(log, self, stage, epoch, i)
             self.step += 1
             self._in_step = False
 
+    def _emit_device_sync(self, tele, drain):
+        """Record one pipeline-drain sample: ``seconds`` is the time the
+        host blocked to resolve the newest step's output (≈0 means the
+        host, not the device, is the bottleneck), ``wall``/``steps`` give
+        the true device pipeline rate over the sampled window."""
+        if not tele.enabled:
+            return
+        now = time.perf_counter()
+        steps = self._dispatched - self._last_sync_dispatched
+        wall = now - self._last_sync_t
+        self._last_sync_dispatched = self._dispatched
+        self._last_sync_t = now
+        tele.emit("device_sync", step=self.step, seconds=round(drain, 6),
+                  steps=steps, wall=round(wall, 6))
+
     def _dump_failed(self, log, stage, epoch):
         log.error("detected non-finite values in final flow field")
+        # auto-flushes the sink (nonfinite is a boundary event): the run
+        # is about to die and the JSONL must survive for the post-mortem
+        telemetry.get().emit("nonfinite", step=self.step, stage=stage.index,
+                             epoch=epoch)
 
         from flax import serialization
 

@@ -1,0 +1,39 @@
+"""Unified telemetry: span timers, JSONL event sink, run reports.
+
+See ``core`` for the sink/schema and ``report`` for rendering. Typical
+producer usage::
+
+    from .. import telemetry
+
+    tele = telemetry.activate(telemetry.create(run_dir / "events.jsonl"))
+    tele.emit("run_start", dir=str(run_dir))
+    with tele.span("dispatch"):
+        state, aux = step_fn(state, lr, *batch)
+    tele.step_event(step, stage=0, epoch=0)
+
+``RMD_TELEMETRY=0`` turns every call into a no-op (``create`` returns the
+null sink and ``activate`` skips the jax.monitoring hookup).
+"""
+
+from . import core, report
+from .core import (
+    SCHEMA,
+    SCHEMA_VERSION,
+    NullTelemetry,
+    Telemetry,
+    activate,
+    create,
+    deactivate,
+    enabled,
+    get,
+    instrument_jit,
+    memory_snapshot,
+    validate_event,
+)
+
+__all__ = [
+    "core", "report",
+    "SCHEMA", "SCHEMA_VERSION", "NullTelemetry", "Telemetry",
+    "activate", "create", "deactivate", "enabled", "get",
+    "instrument_jit", "memory_snapshot", "validate_event",
+]

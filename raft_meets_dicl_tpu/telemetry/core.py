@@ -1,0 +1,353 @@
+"""Run-wide structured telemetry: spans, step phases, JSONL event sink.
+
+Round 5 cut the real training loop from 5.8 to 1.2 s/step only after
+hand-timing exposed three invisible host-side stalls (PERF.md); this
+module makes those visible on *every* run. Each run directory gets an
+``events.jsonl`` whose records follow a versioned schema (``SCHEMA``),
+covering per-step phase timings, throughput, compiles and persistent
+compile-cache hits/misses, memory watermarks, non-finite-guard flushes,
+and stage/epoch/checkpoint boundaries.
+
+Design constraints, in order:
+
+1. **The hot path must stay hot.** Spans are two ``perf_counter`` calls
+   and a dict update; events buffer in memory and flush at boundaries
+   (epoch/stage/run) or every ``_FLUSH_EVERY`` records; device step time
+   is sampled by piggybacking on the amortized finiteness fetch instead
+   of a per-step ``block_until_ready`` (which would serialize the async
+   pipeline — the exact regression round 5 removed).
+2. **Off means off.** ``RMD_TELEMETRY=0`` routes every call site through
+   :class:`NullTelemetry` no-ops; no file is opened, no listener fires.
+3. **One sink per process.** ``activate()`` installs the process-wide
+   sink returned by ``get()``; the jax.monitoring listeners (compile
+   durations, compile-cache hits/misses) are registered once and forward
+   to whatever sink is active.
+"""
+
+import contextlib
+import json
+import os
+import threading
+import time
+
+SCHEMA_VERSION = 1
+
+# kind -> required payload fields (beyond the {v, t, kind} envelope).
+# Extra fields are allowed everywhere: the schema pins the floor a
+# consumer can rely on, not the ceiling.
+SCHEMA = {
+    "run_start": {"dir"},
+    "run_end": set(),
+    "stage_start": {"stage", "step"},
+    "stage_end": {"stage", "step"},
+    "epoch_start": {"stage", "epoch", "step"},
+    "epoch_end": {"stage", "epoch", "step"},
+    "step": {"step", "phases", "step_time", "throughput_ema"},
+    "device_sync": {"step", "seconds"},
+    "compile": {"label", "seconds"},
+    "cache": {"event"},
+    "memory": {"host_rss_gib", "live_arrays"},
+    "nonfinite": {"step"},
+    "checkpoint": {"path", "step", "seconds"},
+}
+
+_FLUSH_EVERY = 128
+_EMA_ALPHA = 0.1
+
+
+def validate_event(ev):
+    """Check one event against the schema; raises ValueError on mismatch.
+
+    Returns the event for chaining. This is the contract the tests and
+    ``telemetry_report`` hold every producer to.
+    """
+    if not isinstance(ev, dict):
+        raise ValueError(f"event is not an object: {ev!r}")
+    if ev.get("v") != SCHEMA_VERSION:
+        raise ValueError(f"unknown schema version {ev.get('v')!r}: {ev!r}")
+    if not isinstance(ev.get("t"), (int, float)):
+        raise ValueError(f"missing/invalid timestamp: {ev!r}")
+    kind = ev.get("kind")
+    if kind not in SCHEMA:
+        raise ValueError(f"unknown event kind {kind!r}: {ev!r}")
+    missing = SCHEMA[kind] - ev.keys()
+    if missing:
+        raise ValueError(f"{kind} event missing {sorted(missing)}: {ev!r}")
+    if kind == "step":
+        phases = ev["phases"]
+        if not isinstance(phases, dict) or not all(
+                isinstance(v, (int, float)) for v in phases.values()):
+            raise ValueError(f"step phases must map name -> seconds: {ev!r}")
+    if kind == "cache" and ev["event"] not in ("hit", "miss"):
+        raise ValueError(f"cache event must be hit|miss: {ev!r}")
+    return ev
+
+
+def enabled():
+    """The documented kill switch: RMD_TELEMETRY=0 disables everything."""
+    return os.environ.get("RMD_TELEMETRY", "1") != "0"
+
+
+class NullTelemetry:
+    """No-op sink — the RMD_TELEMETRY=0 path and the default before
+    ``activate``. Call sites never branch; they just talk to this."""
+
+    path = None
+    last_step = None
+    enabled = False
+
+    def emit(self, kind, **fields):
+        pass
+
+    def span(self, name):
+        return contextlib.nullcontext()
+
+    def add_phase(self, name, seconds):
+        pass
+
+    def step_event(self, step, **fields):
+        pass
+
+    def counts(self):
+        return {}
+
+    def flush(self):
+        pass
+
+    def close(self):
+        pass
+
+
+class Telemetry:
+    """JSONL event sink with a span/phase API.
+
+    ``path=None`` keeps events in memory only (``self.events``) — used by
+    bench.py and tests; a path appends JSON lines to that file.
+    """
+
+    enabled = True
+
+    def __init__(self, path=None):
+        self.path = os.fspath(path) if path is not None else None
+        self.events = []          # in-memory tail (memory-only mode: all)
+        self.last_step = None
+        self._lock = threading.Lock()
+        self._buffer = []
+        self._fd = None
+        self._phases = {}
+        self._counts = {}
+        self._last_step_t = None
+        self._ema = None
+
+    # -- event plumbing ----------------------------------------------------
+
+    def emit(self, kind, **fields):
+        ev = {"v": SCHEMA_VERSION, "t": time.time(), "kind": kind, **fields}
+        with self._lock:
+            self._counts[kind] = self._counts.get(kind, 0) + 1
+            if self.path is None:
+                self.events.append(ev)
+                return ev
+            self._buffer.append(ev)
+            if (len(self._buffer) >= _FLUSH_EVERY
+                    or kind not in ("step", "device_sync", "compile", "cache")):
+                self._flush_locked()
+        return ev
+
+    def _flush_locked(self):
+        if not self._buffer:
+            return
+        if self._fd is None:
+            self._fd = open(self.path, "a")
+        for ev in self._buffer:
+            self._fd.write(json.dumps(ev) + "\n")
+        self._buffer.clear()
+        self._fd.flush()
+
+    def flush(self):
+        with self._lock:
+            if self.path is not None:
+                self._flush_locked()
+
+    def close(self):
+        with self._lock:
+            if self.path is not None:
+                self._flush_locked()
+            if self._fd is not None:
+                self._fd.close()
+                self._fd = None
+
+    def counts(self):
+        """Event counts by kind (cheap snapshot, used by bench summaries)."""
+        with self._lock:
+            return dict(self._counts)
+
+    # -- phases / steps ----------------------------------------------------
+
+    @contextlib.contextmanager
+    def span(self, name):
+        """Accumulate wall time under ``name`` for the current step."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add_phase(name, time.perf_counter() - t0)
+
+    def add_phase(self, name, seconds):
+        """Externally-timed phase contribution (e.g. from the prefetch
+        worker thread — attribution runs up to ``depth`` batches ahead,
+        the aggregate breakdown is what matters)."""
+        with self._lock:
+            self._phases[name] = self._phases.get(name, 0.0) + seconds
+
+    def step_event(self, step, **fields):
+        """Close out one optimizer step: drain accumulated phases, update
+        the throughput EMA, emit the ``step`` record."""
+        now = time.perf_counter()
+        with self._lock:
+            phases = self._phases
+            self._phases = {}
+        if self._last_step_t is None:
+            step_time = sum(phases.values())
+        else:
+            step_time = now - self._last_step_t
+        self._last_step_t = now
+
+        inst = 1.0 / step_time if step_time > 0 else 0.0
+        self._ema = (inst if self._ema is None
+                     else _EMA_ALPHA * inst + (1 - _EMA_ALPHA) * self._ema)
+
+        ev = self.emit(
+            "step", step=step,
+            phases={k: round(v, 6) for k, v in phases.items()},
+            step_time=round(step_time, 6),
+            throughput_ema=round(self._ema, 4),
+            **fields,
+        )
+        self.last_step = ev
+        return ev
+
+
+# -- process-wide active sink + jax.monitoring forwarding -------------------
+
+_active = NullTelemetry()
+_listeners_installed = False
+_jit_label = threading.local()
+
+
+def get():
+    """The process's active sink (NullTelemetry unless activated)."""
+    return _active
+
+
+def activate(sink):
+    """Install ``sink`` as the process-wide telemetry target and hook the
+    jax.monitoring compile/cache events into it. Returns the sink."""
+    global _active
+    _active = sink
+    if sink.enabled:
+        _install_listeners()
+    return sink
+
+
+def deactivate():
+    """Swap back to the null sink (closing the old one)."""
+    global _active
+    old, _active = _active, NullTelemetry()
+    old.close()
+    return old
+
+
+def create(path=None):
+    """Factory honoring the kill switch: a real sink, or the null one."""
+    return Telemetry(path) if enabled() else NullTelemetry()
+
+
+def instrument_jit(label, fn):
+    """Label a jitted callable so compiles triggered inside it are
+    attributed to ``label`` in compile events. Pure passthrough wrapper —
+    donation/sharding semantics of ``fn`` are untouched."""
+
+    def wrapped(*args, **kwargs):
+        prev = getattr(_jit_label, "value", None)
+        _jit_label.value = label
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            _jit_label.value = prev
+
+    wrapped.__wrapped__ = fn
+    wrapped.telemetry_label = label
+    return wrapped
+
+
+def _install_listeners():
+    """Register the process-wide jax.monitoring forwarders (idempotent).
+
+    jax emits '/jax/core/compile/backend_compile_duration' per backend
+    compile and '/jax/compilation_cache/cache_{hits,misses}' per
+    persistent-cache lookup; both forward to whatever sink is active at
+    fire time, labeled by the innermost ``instrument_jit`` wrapper.
+    """
+    global _listeners_installed
+    if _listeners_installed:
+        return
+    try:
+        from jax import monitoring
+    except Exception:  # pragma: no cover - jax always present in practice
+        return
+
+    def on_event(event, **kwargs):
+        if not _active.enabled:
+            return
+        if event == "/jax/compilation_cache/cache_hits":
+            _active.emit("cache", event="hit",
+                         label=getattr(_jit_label, "value", None))
+        elif event == "/jax/compilation_cache/cache_misses":
+            _active.emit("cache", event="miss",
+                         label=getattr(_jit_label, "value", None))
+
+    def on_duration(event, duration, **kwargs):
+        if not _active.enabled:
+            return
+        if event == "/jax/core/compile/backend_compile_duration":
+            _active.emit("compile",
+                         label=getattr(_jit_label, "value", None) or "jit",
+                         seconds=round(float(duration), 6))
+
+    monitoring.register_event_listener(on_event)
+    monitoring.register_event_duration_secs_listener(on_duration)
+    _listeners_installed = True
+
+
+def memory_snapshot():
+    """Host RSS + live jax arrays + device peak bytes (where exposed).
+
+    The promoted form of the old ad-hoc ``RMD_DEBUG_MEM`` print — cheap
+    enough to take at every epoch boundary.
+    """
+    rss = 0.0
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    rss = int(line.split()[1]) / 2 ** 20
+                    break
+    except OSError:  # pragma: no cover - non-procfs platforms
+        pass
+
+    snap = {"host_rss_gib": round(rss, 3), "live_arrays": 0}
+    try:
+        import jax
+
+        snap["live_arrays"] = len(jax.live_arrays())
+        stats = jax.local_devices()[0].memory_stats() or {}
+        if "peak_bytes_in_use" in stats:
+            snap["device_peak_gib"] = round(
+                stats["peak_bytes_in_use"] / 2 ** 30, 3)
+        if "bytes_in_use" in stats:
+            snap["device_bytes_gib"] = round(
+                stats["bytes_in_use"] / 2 ** 30, 3)
+    except Exception:  # noqa: BLE001 - telemetry must never break the run
+        pass
+    return snap

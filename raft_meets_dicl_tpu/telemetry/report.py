@@ -1,0 +1,244 @@
+"""Render a run's ``events.jsonl`` into a phase-breakdown report.
+
+Pure functions over the event stream (no jax import): used by
+``scripts/telemetry_report.py`` for the CLI rendering and by the tests
+to hold the producers to the schema. The report answers the question
+round 5 needed a dedicated debugging round for: *where do each step's
+milliseconds go, and did anything anomalous happen?*
+"""
+
+import json
+
+from .core import validate_event
+
+# a compile this many optimizer steps after its stage started is a
+# recompile — the per-stage step build compiles during the first step
+DEFAULT_WARMUP_STEPS = 3
+DEFAULT_SPIKE_FACTOR = 3.0
+
+
+def load_events(path):
+    """Parse + validate a JSONL file. Returns (events, errors) where
+    errors are (line_number, message) for records that fail the schema —
+    a report over a partially-corrupt file still renders what it can."""
+    events, errors = [], []
+    with open(path) as fd:
+        for n, line in enumerate(fd, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(validate_event(json.loads(line)))
+            except (json.JSONDecodeError, ValueError) as e:
+                errors.append((n, str(e)))
+    return events, errors
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def phase_stats(events):
+    """Per-phase timing stats over all step events.
+
+    Returns {phase: {mean, p95, max, total, share}} in seconds, where
+    ``share`` is the phase's fraction of total step wall time, plus the
+    synthetic phases ``step`` (step wall time) and ``other`` (wall time
+    not covered by any span: callbacks, validation, scheduler ticks).
+    """
+    steps = [e for e in events if e["kind"] == "step"]
+    if not steps:
+        return {}
+
+    total_wall = sum(e["step_time"] for e in steps)
+    names = sorted({n for e in steps for n in e["phases"]})
+    out = {}
+    for name in names:
+        vals = sorted(e["phases"].get(name, 0.0) for e in steps)
+        total = sum(vals)
+        out[name] = {
+            "mean": total / len(vals),
+            "p95": _percentile(vals, 0.95),
+            "max": vals[-1],
+            "total": total,
+            "share": total / total_wall if total_wall else 0.0,
+        }
+
+    walls = sorted(e["step_time"] for e in steps)
+    out["step"] = {
+        "mean": total_wall / len(walls),
+        "p95": _percentile(walls, 0.95),
+        "max": walls[-1],
+        "total": total_wall,
+        "share": 1.0,
+    }
+    covered = sum(s["total"] for n, s in out.items() if n != "step")
+    other = max(0.0, total_wall - covered)
+    out["other"] = {
+        "mean": other / len(steps),
+        "p95": float("nan"),
+        "max": float("nan"),
+        "total": other,
+        "share": other / total_wall if total_wall else 0.0,
+    }
+    return out
+
+
+def device_step_time(events):
+    """Mean device-pipeline seconds/step from the periodic sync samples.
+
+    Each ``device_sync`` event covers the ``steps`` dispatches since the
+    previous sample; ``wall`` (when present) is the wall time across them
+    and ``seconds`` the drain time at the sample point — drain ≈ 0 means
+    the host, not the device, is the bottleneck.
+    """
+    syncs = [e for e in events if e["kind"] == "device_sync"]
+    covered = sum(e.get("steps", 1) for e in syncs)
+    if not covered:
+        return None
+    wall = sum(e.get("wall", e["seconds"]) for e in syncs)
+    drain = sum(e["seconds"] for e in syncs)
+    return {"samples": len(syncs), "steps_covered": covered,
+            "mean_step": wall / covered, "mean_drain": drain / len(syncs)}
+
+
+def find_anomalies(events, warmup_steps=DEFAULT_WARMUP_STEPS,
+                   spike_factor=DEFAULT_SPIKE_FACTOR):
+    """Flag step-time spikes, recompiles after warmup, and non-finite
+    flushes. Returns a list of human-readable strings (empty = clean)."""
+    flags = []
+
+    # per-stage spike detection: stages change shapes/optimizers, so a
+    # global median would mis-flag every stage transition
+    by_stage = {}
+    for e in events:
+        if e["kind"] == "step":
+            by_stage.setdefault(e.get("stage"), []).append(e)
+    for stage, steps in by_stage.items():
+        if len(steps) < 4:
+            continue
+        walls = sorted(s["step_time"] for s in steps)
+        median = walls[len(walls) // 2]
+        if median <= 0:
+            continue
+        for s in steps:
+            if s["step_time"] > spike_factor * median:
+                flags.append(
+                    f"step-time spike: step {s['step']} took "
+                    f"{s['step_time'] * 1e3:.0f} ms "
+                    f"({s['step_time'] / median:.1f}x the stage median)")
+
+    # recompiles: a compile after `warmup_steps` optimizer steps of the
+    # current stage means something re-traced mid-stage (shape drift,
+    # cache invalidation) — exactly the silent cost telemetry exists for
+    steps_in_stage = 0
+    for e in events:
+        if e["kind"] == "stage_start":
+            steps_in_stage = 0
+        elif e["kind"] == "step":
+            steps_in_stage += 1
+        elif e["kind"] == "compile" and steps_in_stage > warmup_steps:
+            flags.append(
+                f"recompile after warmup: '{e['label']}' compiled for "
+                f"{e['seconds']:.2f} s after {steps_in_stage} steps in-stage")
+
+    for e in events:
+        if e["kind"] == "nonfinite":
+            flags.append(
+                f"non-finite guard tripped at step {e['step']}"
+                + (f" (stage {e['stage']})" if "stage" in e else ""))
+
+    return flags
+
+
+def _fmt_ms(seconds):
+    try:
+        return f"{seconds * 1e3:9.2f}"
+    except (TypeError, ValueError):  # pragma: no cover
+        return "        -"
+
+
+def render(events, errors=(), warmup_steps=DEFAULT_WARMUP_STEPS,
+           spike_factor=DEFAULT_SPIKE_FACTOR):
+    """The full plain-text report."""
+    lines = []
+    steps = [e for e in events if e["kind"] == "step"]
+    compiles = [e for e in events if e["kind"] == "compile"]
+    caches = [e for e in events if e["kind"] == "cache"]
+    stages = [e for e in events if e["kind"] == "stage_start"]
+    memory = [e for e in events if e["kind"] == "memory"]
+    checkpoints = [e for e in events if e["kind"] == "checkpoint"]
+
+    lines.append("== run summary ==")
+    lines.append(
+        f"events: {len(events)}  stages: {len(stages)}  "
+        f"optimizer steps: {len(steps)}  checkpoints: {len(checkpoints)}")
+    if errors:
+        lines.append(f"schema errors: {len(errors)} "
+                     f"(first: line {errors[0][0]}: {errors[0][1]})")
+    if steps:
+        ema = steps[-1]["throughput_ema"]
+        lines.append(f"final throughput EMA: {ema:.3f} steps/s")
+
+    stats = phase_stats(events)
+    if stats:
+        lines.append("")
+        lines.append("== step phase breakdown (ms) ==")
+        lines.append(f"{'phase':<14} {'mean':>9} {'p95':>9} {'max':>9} "
+                     f"{'share':>7}")
+        order = sorted((n for n in stats if n not in ("step", "other")),
+                       key=lambda n: -stats[n]["total"])
+        for name in order + ["other", "step"]:
+            s = stats[name]
+            lines.append(
+                f"{name:<14} {_fmt_ms(s['mean'])} {_fmt_ms(s['p95'])} "
+                f"{_fmt_ms(s['max'])} {s['share'] * 100:6.1f}%")
+
+    dev = device_step_time(events)
+    if dev:
+        lines.append("")
+        lines.append(
+            f"device pipeline: {dev['mean_step'] * 1e3:.2f} ms/step over "
+            f"{dev['steps_covered']} sampled steps "
+            f"({dev['samples']} syncs, mean drain "
+            f"{dev['mean_drain'] * 1e3:.2f} ms)")
+
+    if compiles or caches:
+        lines.append("")
+        lines.append("== compiles ==")
+        by_label = {}
+        for c in compiles:
+            agg = by_label.setdefault(c["label"], [0, 0.0])
+            agg[0] += 1
+            agg[1] += c["seconds"]
+        for label, (n, secs) in sorted(by_label.items()):
+            lines.append(f"{label:<20} {n:3d} compiles  {secs:8.2f} s")
+        hits = sum(1 for c in caches if c["event"] == "hit")
+        misses = sum(1 for c in caches if c["event"] == "miss")
+        lines.append(f"persistent compile cache: {hits} hits, "
+                     f"{misses} misses")
+
+    if memory:
+        peak_rss = max(m["host_rss_gib"] for m in memory)
+        lines.append("")
+        line = (f"memory watermarks: host rss {peak_rss:.2f} GiB, "
+                f"live arrays max {max(m['live_arrays'] for m in memory)}")
+        dev_peaks = [m["device_peak_gib"] for m in memory
+                     if "device_peak_gib" in m]
+        if dev_peaks:
+            line += f", device peak {max(dev_peaks):.2f} GiB"
+        lines.append(line)
+
+    flags = find_anomalies(events, warmup_steps=warmup_steps,
+                           spike_factor=spike_factor)
+    lines.append("")
+    if flags:
+        lines.append(f"== anomalies ({len(flags)}) ==")
+        lines.extend(f"  ! {f}" for f in flags)
+    else:
+        lines.append("== anomalies: none ==")
+
+    return "\n".join(lines)

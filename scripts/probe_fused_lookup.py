@@ -161,6 +161,14 @@ def main():
         t_b, out_b = _time(p1, wy, corr, steps=args.steps)
         print(f"B  Pallas stage-1 dots:              {t_b * 1e3:8.3f} ms"
               f"  ({flops_s1 / t_b / 1e12:.2f} TFLOP/s)")
+        # bit-exactness of B is part of the PERF.md claim, so verify it
+        # against the same stage-1 contraction XLA runs (f32 accumulate),
+        # not just C's end-to-end output
+        ref_s1 = jax.jit(lambda w, c: jnp.einsum(
+            "bijkh,bijhw->bijkw", w, c,
+            preferred_element_type=jnp.float32))(wy, corr)
+        err_b = float(jnp.max(jnp.abs(out_b - ref_s1)))
+        print(f"   max |B - A| = {err_b:.3e}  (stage-1 intermediate)")
     except Exception as e:  # pragma: no cover - probe reporting
         print(f"B  Pallas stage-1 dots: FAILED ({type(e).__name__}: "
               f"{str(e)[:140]})")

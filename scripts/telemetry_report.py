@@ -1,0 +1,65 @@
+#!/usr/bin/env python3
+"""Render a run's telemetry events into a phase-breakdown report.
+
+Reads the ``events.jsonl`` a training run writes by default (or any file
+produced by ``raft_meets_dicl_tpu.telemetry``), validates every record
+against the versioned schema, prints per-phase step timing stats
+(mean/p95/max/share), compile + persistent-cache counts, memory
+watermarks, and flags anomalies: step-time spikes, recompiles after
+warmup, and non-finite-guard events.
+
+    python scripts/telemetry_report.py runs/<ts>/events.jsonl
+    python scripts/telemetry_report.py runs/<ts>          # finds the file
+    python scripts/telemetry_report.py events.jsonl --strict
+"""
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+from raft_meets_dicl_tpu.telemetry import report  # noqa: E402
+
+
+def resolve(path):
+    p = Path(path)
+    if p.is_dir():
+        candidate = p / "events.jsonl"
+        if not candidate.exists():
+            raise SystemExit(f"no events.jsonl under '{p}'")
+        return candidate
+    if not p.exists():
+        raise SystemExit(f"no such file: '{p}'")
+    return p
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="render a telemetry events.jsonl into a report")
+    ap.add_argument("path", help="events.jsonl file or run directory")
+    ap.add_argument("--warmup-steps", type=int,
+                    default=report.DEFAULT_WARMUP_STEPS,
+                    help="compiles after this many in-stage steps are "
+                         "flagged as recompiles [default: %(default)s]")
+    ap.add_argument("--spike-factor", type=float,
+                    default=report.DEFAULT_SPIKE_FACTOR,
+                    help="flag steps slower than this multiple of the "
+                         "stage median [default: %(default)s]")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit non-zero on schema errors or anomalies")
+    args = ap.parse_args(argv)
+
+    events, errors = report.load_events(resolve(args.path))
+    print(report.render(events, errors, warmup_steps=args.warmup_steps,
+                        spike_factor=args.spike_factor))
+
+    flags = report.find_anomalies(events, warmup_steps=args.warmup_steps,
+                                  spike_factor=args.spike_factor)
+    if args.strict and (errors or flags):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
