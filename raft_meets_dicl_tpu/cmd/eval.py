@@ -75,13 +75,26 @@ def evaluate(args):
     logging.info(f"loading data specification, file='{args.data}'")
     compute_metrics = not args.flow_only
 
-    dataset = data.load(args.data)
-    loader = input.apply(dataset).jax(compute_metrics).loader(
-        batch_size=args.batch_size, shuffle=False, drop_last=False,
-    )
+    # wire format: images cross host->device compact and un-normalized,
+    # normalization runs inside the jitted eval step
+    from ..models.wire import WireFormat
 
-    # variables from the checkpoint (structure target from a sample init)
+    wire = WireFormat.from_config(getattr(args, "wire_format", None))
+    if wire is not None:
+        wire = wire.bound(input.clip, input.range)
+        logging.info(f"input wire format: {wire.describe()}")
+
+    dataset = data.load(args.data)
+    loader = input.apply(dataset, normalize=wire is None).jax(
+        compute_metrics, wire=wire,
+    ).loader(batch_size=args.batch_size, shuffle=False, drop_last=False)
+
+    # variables from the checkpoint (structure target from a sample init;
+    # init wants the normalized f32 contract, not the wire dtype)
     img1, img2, *_ = loader.source[0]
+    if wire is not None:
+        img1 = wire.decode_images_host(img1)
+        img2 = wire.decode_images_host(img2)
     variables = model.init(jax.random.PRNGKey(0), img1[:1], img2[:1])
     variables, _, _ = chkpt.apply(variables=variables)
 
@@ -113,7 +126,8 @@ def evaluate(args):
     output = []
     ctx_m = metrics.MetricContext()
 
-    for sample in evaluation.evaluate(model, variables, loader, mesh=mesh):
+    for sample in evaluation.evaluate(model, variables, loader, mesh=mesh,
+                                      wire=wire):
         target = sample.target[None] if sample.target is not None else None
         valid = sample.valid[None] if sample.valid is not None else None
         est = sample.final[None]

@@ -27,8 +27,9 @@ _DEFAULT_INSPECT = Path(__file__).parent.parent.parent / "cfg" / "inspect" / "de
 
 
 class Environment:
-    """Loader arguments + backend flags (reference Environment,
-    src/cmd/train.py:18-42; cudnn switches become jax/XLA ones)."""
+    """Loader arguments + wire format + backend flags (reference
+    Environment, src/cmd/train.py:18-42; cudnn switches become jax/XLA
+    ones, plus the host→device wire-format section)."""
 
     @classmethod
     def load(cls, cfg):
@@ -37,18 +38,24 @@ class Environment:
 
         return cls(
             loader_args=cfg.get("loader", {}),
+            wire=cfg.get("wire"),
             debug_nans=cfg.get("jax", {}).get("debug-nans", False),
             deterministic=cfg.get("jax", {}).get("deterministic", False),
         )
 
-    def __init__(self, loader_args={}, debug_nans=False, deterministic=False):
+    def __init__(self, loader_args={}, wire=None, debug_nans=False,
+                 deterministic=False):
         self.loader_args = dict(loader_args)
+        # wire config: preset name ('f32'/'bf16'/'u8') or mapping with
+        # images/flow/pack-valid keys (models.wire.WireFormat.from_config)
+        self.wire = wire
         self.debug_nans = debug_nans
         self.deterministic = deterministic
 
     def get_config(self):
         return {
             "loader": self.loader_args,
+            "wire": self.wire,
             "jax": {
                 "debug-nans": self.debug_nans,
                 "deterministic": self.deterministic,
@@ -296,11 +303,28 @@ def _train(args):
             "saved config not sufficient for reproducibility due to checkpoint data"
         )
 
+    # wire format: CLI flag > RMD_WIRE_FORMAT > env config. None keeps the
+    # legacy host-normalized f32 batches.
+    import os
+
+    from ..models.wire import WireFormat
+
+    wire_cfg = (getattr(args, "wire_format", None)
+                or os.environ.get("RMD_WIRE_FORMAT")
+                or env.wire)
+    wire = WireFormat.from_config(wire_cfg)
+    if wire is not None:
+        logging.info(f"input wire format: {wire.describe()}")
+
+    loader_args = dict(env.loader_args)
+    if getattr(args, "loader_procs", None) is not None:
+        loader_args["procs"] = args.loader_procs
+
     log = utils.logging.Logger()
     tctx = TrainingContext(
         log, path_out, strat, model_id, model_spec, model_adapter, loss, input,
         inspector, chkptm, mesh=mesh, step_limit=args.steps,
-        loader_args=env.loader_args,
+        loader_args=loader_args, wire=wire,
     )
 
     if args.checkpoint:

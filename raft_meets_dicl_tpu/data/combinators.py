@@ -5,6 +5,8 @@ repeat.py, subset.py) but implemented in one module — they are all thin
 index-transformers over a source Collection.
 """
 
+from dataclasses import replace
+
 import numpy as np
 
 from .collection import Collection
@@ -125,7 +127,7 @@ class Cache(Collection):
     def __getitem__(self, index):
         hit = self._cache.get(index)
         if hit is not None:
-            return hit
+            return self._fresh_meta(hit)
 
         sample = self.source[index]
         img1, img2, flow, valid, meta = sample
@@ -137,7 +139,11 @@ class Cache(Collection):
                 # any consumer ever mutate a sample in place
                 if a is not None and a.flags.owndata:
                     a.setflags(write=False)
-            self._cache[index] = sample
+            # store a pristine Metadata copy: the adapter flips
+            # ``meta.valid`` in place on transiently-bad batches, and a
+            # retained reference would poison this sample for every
+            # later epoch
+            self._cache[index] = self._fresh_meta(sample)
             self._bytes += size
         elif not self._warned:
             self._warned = True
@@ -149,6 +155,11 @@ class Cache(Collection):
                 f"samples stream uncached")
         return sample
 
+    @staticmethod
+    def _fresh_meta(sample):
+        img1, img2, flow, valid, meta = sample
+        return img1, img2, flow, valid, [replace(m) for m in meta]
+
     def __len__(self):
         return len(self.source)
 
@@ -157,7 +168,14 @@ class Cache(Collection):
 
 
 class Subset(Collection):
-    """Random subset with replacement, drawn once at construction."""
+    """Random subset with replacement, drawn once at construction.
+
+    The draw comes from an own ``Generator``: an explicit config ``seed``
+    pins the subset outright; without one the seed derives from the
+    (run-seeded, utils.seeds) global numpy RNG — one draw, so the subset
+    stays reproducible without coupling its contents to how many global
+    draws other pipeline stages happened to consume first.
+    """
 
     type = "subset"
 
@@ -166,16 +184,21 @@ class Subset(Collection):
         from . import config as data_config
 
         cls._typecheck(cfg)
-        return cls(cfg["size"], data_config.load(path, cfg["source"]))
+        return cls(cfg["size"], data_config.load(path, cfg["source"]),
+                   seed=cfg.get("seed"))
 
-    def __init__(self, size, source):
+    def __init__(self, size, source, seed=None):
         super().__init__()
         self.size = size
         self.source = source
+        if seed is None:
+            seed = int(np.random.randint(0, 2**31 - 1))
+        self.seed = int(seed)
         # an empty source yields an empty subset (a not-yet-populated
         # dataset root must still spec-load)
         n = len(source)
-        self.map = (np.random.randint(0, n, size=size) if n
+        rng = np.random.default_rng(self.seed)
+        self.map = (rng.integers(0, n, size=size) if n
                     else np.empty(0, np.int64))
 
     def __len__(self):
@@ -185,6 +208,7 @@ class Subset(Collection):
         return {
             "type": self.type,
             "size": self.size,
+            "seed": self.seed,
             "source": self.source.get_config(),
         }
 

@@ -63,15 +63,17 @@ def static_args_key(args):
     return tuple(parts)
 
 
-def _cache_key(model, model_args, mesh=None):
+def _cache_key(model, model_args, mesh=None, wire=None):
     args_key = static_args_key(model_args)
     if args_key is None:
         return None
     mesh_key = None if mesh is None else tuple(d.id for d in mesh.devices.flat)
-    return (id(model), args_key, mesh_key)
+    wire_key = None if wire is None else (
+        wire.images, wire.flow, wire.pack_valid, wire.clip, wire.range)
+    return (id(model), args_key, mesh_key, wire_key)
 
 
-def make_eval_fn(model, model_args=None, mesh=None):
+def make_eval_fn(model, model_args=None, mesh=None, wire=None):
     """Jitted ``(variables, img1, img2) -> (raw_output, final_flow)``.
 
     With ``mesh`` (a 1-D ``jax.sharding.Mesh`` over a ``data`` axis) the
@@ -79,15 +81,20 @@ def make_eval_fn(model, model_args=None, mesh=None):
     sharded on the leading axis (reference wraps eval in nn.DataParallel,
     src/cmd/eval.py:144-145) — callers must pad batches to a multiple of
     the mesh size (``evaluate`` does).
+
+    ``wire`` (models.wire.WireFormat) accepts compact-dtype un-normalized
+    images and decodes + normalizes them on device.
     """
     model_args = dict(model_args or {})
-    key = _cache_key(model, model_args, mesh)
+    key = _cache_key(model, model_args, mesh, wire)
     if key is not None and key in _EVAL_FN_CACHE:
         return _EVAL_FN_CACHE[key]
 
     adapter = model.get_adapter()
 
     def step(variables, img1, img2):
+        if wire is not None:
+            img1, img2, _, _ = wire.decode(img1, img2)
         out = model.apply(variables, img1, img2, train=False, **model_args)
         result = adapter.wrap_result(out, img1.shape[1:3])
         return out, result.final()
@@ -109,7 +116,7 @@ def make_eval_fn(model, model_args=None, mesh=None):
 
 
 def evaluate(model, variables, data, model_args=None, show_progress=True,
-             eval_fn=None, mesh=None):
+             eval_fn=None, mesh=None, wire=None):
     """Yield an ``EvalSample`` per dataset sample.
 
     ``data`` iterates batches ``(img1, img2, flow, valid, meta)`` in NHWC
@@ -120,10 +127,15 @@ def evaluate(model, variables, data, model_args=None, show_progress=True,
     With ``mesh`` the batch is sharded over the mesh's ``data`` axis;
     short batches are padded by repeating the last sample (padded outputs
     are dropped — only real samples are yielded).
+
+    With ``wire``, ``data`` must yield wire-format batches (an adapter
+    built with the same WireFormat): images upload compact and decode on
+    device; the yielded ``EvalSample.img1/img2`` are decoded back to the
+    normalized f32 contract on the host.
     """
     adapter = model.get_adapter()
     step = (eval_fn if eval_fn is not None
-            else make_eval_fn(model, model_args, mesh=mesh))
+            else make_eval_fn(model, model_args, mesh=mesh, wire=wire))
 
     if show_progress:
         data = utils.logging.progress(data, unit="batch", leave=False)
@@ -147,6 +159,9 @@ def evaluate(model, variables, data, model_args=None, show_progress=True,
     def drain(dispatched):
         (img1, img2, flow, valid, meta), out, final = dispatched
         batch = img1.shape[0]
+        if wire is not None:
+            img1 = wire.decode_images_host(img1)
+            img2 = wire.decode_images_host(img2)
         # device_get blocks the host, not the device — with the next
         # batch already dispatched (below) the result download and the
         # host-side metrics overlap its compute, instead of the strict

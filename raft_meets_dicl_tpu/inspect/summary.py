@@ -453,6 +453,17 @@ class SummaryInspector(Inspector):
             or any(h.needs_grads for h in self.hooks)
         )
 
+    def wants_host_images(self, step):
+        """Pixel values are only read on intermediates-capture and
+        image-dump steps — the wire-format trainer skips the host decode
+        everywhere else."""
+        if any(h.active and h.needs_intermediates
+               and step % getattr(h, "frequency", 1) == 0
+               for h in self.hooks):
+            return True
+        return (self.images is not None
+                and step % self.images.frequency == 0)
+
     # -- hook phase management (src/inspect/summary.py:530-562) -------------
 
     def setup(self, log, ctx):

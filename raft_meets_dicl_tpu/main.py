@@ -89,6 +89,15 @@ def main():
     train.add_argument("--no-telemetry", action="store_true",
                        help="disable run telemetry "
                             "(equivalent to RMD_TELEMETRY=0)")
+    train.add_argument("--wire-format", choices=["f32", "bf16", "u8"],
+                       help="host->device batch wire format: compact image "
+                            "dtype + on-device normalization (also: "
+                            "RMD_WIRE_FORMAT or the env config's 'wire' "
+                            "section) [default: host-normalized f32]")
+    train.add_argument("--loader-procs", type=int, metavar="N",
+                       help="decode the input pipeline in N worker "
+                            "processes (shared-memory transport); 0 = "
+                            "thread pool (also: RMD_LOADER_PROCS)")
 
     # subcommand: evaluate
     eval_ = subp.add_parser("evaluate", aliases=["e", "eval"], formatter_class=fmtcls,
@@ -125,6 +134,10 @@ def main():
                        help="jax platform to use (tpu, cpu) [default: backend default]")
     eval_.add_argument("--device-ids",
                        help="comma-separated device indices")
+    eval_.add_argument("--wire-format", choices=["f32", "bf16", "u8"],
+                       help="host->device batch wire format (compact image "
+                            "dtype, on-device normalization) "
+                            "[default: host-normalized f32]")
 
     # subcommand: checkpoint
     chkpt = subp.add_parser("checkpoint", formatter_class=fmtcls,

@@ -8,6 +8,8 @@ GIL) rather than a torch DataLoader with worker processes.
 """
 
 import concurrent.futures
+import copy
+import os
 from dataclasses import replace
 
 import numpy as np
@@ -36,6 +38,17 @@ class Padding:
 
     def __call__(self, img1, img2, flow, valid, meta):
         return self.apply(img1, img2, flow, valid, meta)
+
+    def raw_variant(self, clip, range):
+        """Variant for un-normalized (wire-format) pipelines.
+
+        Constant padding values are defined in *normalized* space
+        ("zeros" pads with normalized 0); when normalization moves into
+        the jitted step, the host pads raw values, so constants must be
+        mapped through the inverse normalization. Non-constant modes
+        (edge/reflect/...) are value-independent and pass through.
+        """
+        return self
 
 
 class ModuloPadding(Padding):
@@ -107,8 +120,24 @@ class ModuloPadding(Padding):
             return total // 2, total - total // 2
         return total, 0
 
+    def raw_variant(self, clip, range):
+        mode, args = self._ALIASES.get(self.mode, (self.mode, {}))
+        if "constant_values" not in args:
+            return self
+        rmin, rmax = range
+        lo, hi = clip
+        c = (args["constant_values"] - rmin) / (rmax - rmin)
+        out = copy.copy(self)
+        # raw-space constant, clipped into the clip interval so the
+        # device-side clip+scale maps it back to the normalized constant
+        out._raw_constant = float(min(max(c, lo), hi))
+        return out
+
     def apply(self, img1, img2, flow, valid, meta):
         mode, args = self._ALIASES.get(self.mode, (self.mode, {}))
+        raw = getattr(self, "_raw_constant", None)
+        if raw is not None and "constant_values" in args:
+            args = dict(args, constant_values=raw)
 
         _, h, w, _ = img1.shape
         new_h = -(-h // self.size[1]) * self.size[1]
@@ -185,8 +214,11 @@ class InputSpec:
             "padding": self.padding.get_config() if self.padding is not None else None,
         }
 
-    def apply(self, source):
-        return Input(source, self.clip, self.range, self.padding)
+    def apply(self, source, normalize=True):
+        """Wrap ``source``; ``normalize=False`` defers the clip/range
+        scaling to the device (wire-format pipelines)."""
+        return Input(source, self.clip, self.range, self.padding,
+                     normalize=normalize)
 
     def wrap_single(self, img1, img2, flow=None, valid=None, seq=0, dsid="custom"):
         """Wrap one unbatched image pair as a one-sample input source."""
@@ -213,22 +245,34 @@ class InputSpec:
 
 
 class Input:
-    """Applies clip + range scaling + padding over a Collection."""
+    """Applies clip + range scaling + padding over a Collection.
 
-    def __init__(self, source, clip=(0.0, 1.0), range=(-1.0, 1.0), padding=None):
+    With ``normalize=False`` the clip/range scaling is skipped — the
+    wire-format path applies it inside the jitted step instead
+    (``models.wire.WireFormat.decode``) — and constant padding values
+    are translated into raw space so device-side normalization maps the
+    padding back onto the configured normalized constant.
+    """
+
+    def __init__(self, source, clip=(0.0, 1.0), range=(-1.0, 1.0),
+                 padding=None, normalize=True):
         self.source = source
         self.clip = clip
         self.range = range
+        self.normalize = normalize
         self.padding = padding
+        if padding is not None and not normalize:
+            self.padding = padding.raw_variant(clip, range)
 
     def __getitem__(self, index):
         img1, img2, flow, valid, meta = self.source[index]
 
-        lo, hi = self.clip
-        rmin, rmax = self.range
+        if self.normalize:
+            lo, hi = self.clip
+            rmin, rmax = self.range
 
-        img1 = (rmax - rmin) * np.clip(img1, lo, hi) + rmin
-        img2 = (rmax - rmin) * np.clip(img2, lo, hi) + rmin
+            img1 = (rmax - rmin) * np.clip(img1, lo, hi) + rmin
+            img2 = (rmax - rmin) * np.clip(img2, lo, hi) + rmin
 
         if self.padding is not None:
             img1, img2, flow, valid, meta = self.padding(img1, img2, flow, valid, meta)
@@ -238,8 +282,8 @@ class Input:
     def __len__(self):
         return len(self.source)
 
-    def jax(self, flow=True):
-        return JaxAdapter(self, flow)
+    def jax(self, flow=True, wire=None):
+        return JaxAdapter(self, flow, wire=wire)
 
     # alias so call sites written against the reference's `.torch()` read
     # naturally during porting
@@ -257,10 +301,11 @@ class JaxAdapter:
     like the reference (src/models/input.py:252-299).
     """
 
-    def __init__(self, source, flow=True, validate=True):
+    def __init__(self, source, flow=True, validate=True, wire=None):
         self.source = source
         self.flow = flow
         self.validate = validate
+        self.wire = wire
         self.log = utils.logging.Logger("data:jax-adapter")
 
     def __getitem__(self, index):
@@ -269,8 +314,17 @@ class JaxAdapter:
         if self.validate:
             self._validate_images(img1, img2, meta)
 
-        img1 = np.ascontiguousarray(img1, dtype=np.float32)
-        img2 = np.ascontiguousarray(img2, dtype=np.float32)
+        if self.wire is not None:
+            # wire compression of the images happens here, inside the
+            # loader workers: the compact form is what crosses thread /
+            # process / device boundaries. Flow and valid stay exact for
+            # host consumers (metrics, inspector); their wire compression
+            # is applied at device-put time (WireFormat.encode_batch).
+            img1 = self.wire.encode_image(img1)
+            img2 = self.wire.encode_image(img2)
+        else:
+            img1 = np.ascontiguousarray(img1, dtype=np.float32)
+            img2 = np.ascontiguousarray(img2, dtype=np.float32)
 
         if not self.flow:
             return img1, img2, None, None, meta
@@ -319,9 +373,11 @@ class JaxAdapter:
         return len(self.source)
 
     def loader(self, batch_size=1, shuffle=False, num_workers=4, drop_last=False,
-               seed=None, shard=None, **loader_args):
+               seed=None, shard=None, procs=None):
+        # no **kwargs catch-all: unknown loader arguments (typos in env
+        # configs) must fail loudly instead of being silently dropped
         return Loader(self, batch_size, shuffle, num_workers, drop_last, seed,
-                      shard)
+                      shard, procs)
 
 
 def collate(samples, shuffle=False, rng=None):
@@ -354,12 +410,15 @@ def collate(samples, shuffle=False, rng=None):
 
 
 class Loader:
-    """Thread-pooled batching iterator over an adapter.
+    """Batching iterator over an adapter: threads or decode processes.
 
     Epoch order reshuffles on every ``__iter__`` when ``shuffle`` is set;
-    within-batch shuffle mixes samples from pre-batched sources. Threads
-    (not processes) are enough here because cv2/numpy release the GIL for
-    the heavy work.
+    within-batch shuffle mixes samples from pre-batched sources. The
+    default transport is a thread pool (cv2/numpy release the GIL for the
+    heavy work); ``procs > 0`` switches to a decode-process pool with
+    shared-memory array transport (models.mpdecode) for pipelines whose
+    pure-Python decode path is the bottleneck. ``procs=None`` reads
+    ``RMD_LOADER_PROCS`` (0 or unset = thread pool).
 
     Shuffling uses an own Generator. Without an explicit ``seed`` it is
     derived from the global numpy RNG so run-level seeding
@@ -373,13 +432,16 @@ class Loader:
     """
 
     def __init__(self, source, batch_size=1, shuffle=False, num_workers=4,
-                 drop_last=False, seed=None, shard=None):
+                 drop_last=False, seed=None, shard=None, procs=None):
         self.source = source
         self.batch_size = batch_size
         self.shuffle = shuffle
         self.num_workers = num_workers
         self.drop_last = drop_last
         self.shard = shard
+        if procs is None:
+            procs = int(os.environ.get("RMD_LOADER_PROCS", "0"))
+        self.procs = max(0, int(procs))
         if seed is None:
             seed = int(np.random.randint(0, 2**31 - 1))
         self.rng = np.random.default_rng(seed)
@@ -414,6 +476,10 @@ class Loader:
             yield chunk
 
     def __iter__(self):
+        if self.procs > 0:
+            yield from self._iter_procs()
+            return
+
         if self.num_workers <= 0:
             for chunk in self._batches():
                 samples = [self.source[i] for i in chunk]
@@ -437,3 +503,37 @@ class Loader:
                 samples = [f.result() for f in futures]
                 submit_next()
                 yield collate(samples, self.shuffle, self.rng)
+
+    def _iter_procs(self):
+        """Decode-process path: same two-batch pipelining as the thread
+        pool, with samples crossing back through shared memory. Segments
+        are released right after collate copies out of them."""
+        from . import mpdecode
+
+        pool = mpdecode.DecodePool(self.source, self.procs)
+        try:
+            pending = []
+            batches = self._batches()
+
+            def submit_next():
+                chunk = next(batches, None)
+                if chunk is not None:
+                    pending.append([pool.submit(i) for i in chunk])
+
+            submit_next()
+            submit_next()
+            while pending:
+                seqs = pending.pop(0)
+                samples, segments = [], []
+                for seq in seqs:
+                    sample, shm = pool.result(seq)
+                    samples.append(sample)
+                    segments.append(shm)
+                submit_next()
+                batch = collate(samples, self.shuffle, self.rng)
+                for shm in segments:
+                    shm.close()
+                    shm.unlink()
+                yield batch
+        finally:
+            pool.shutdown()

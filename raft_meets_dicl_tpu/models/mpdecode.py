@@ -1,0 +1,165 @@
+"""Multiprocess sample decode with shared-memory array transport.
+
+The thread-pooled loader overlaps I/O and the GIL-releasing parts of
+cv2/numpy, but the pure-Python decode path (dataset indexing, augmentation
+glue, per-sample validation) stays single-core. This pool forks worker
+processes that run ``source[index]`` and hand the resulting arrays back
+through POSIX shared memory — one segment per sample, written once by the
+worker, read zero-copy by the consumer (``collate`` is the single copy),
+then unlinked. Only the metadata list travels through the result queue's
+pickle channel.
+
+Fork start method by default (the source pipeline is inherited, nothing
+is pickled); override with ``RMD_LOADER_MP=spawn`` for sources that hold
+fork-unsafe state. Workers never touch jax.
+"""
+
+import multiprocessing as mp
+import os
+import pickle
+from multiprocessing import shared_memory
+
+import numpy as np
+
+
+def _unregister_tracker(name):
+    """Detach a segment from the creating process's resource tracker.
+
+    SharedMemory(create=True) registers with the *worker's* tracker; the
+    consumer unlinks explicitly, so tracker cleanup at worker exit would
+    only race it and log spurious leak warnings.
+    """
+    try:
+        from multiprocessing import resource_tracker
+
+        resource_tracker.unregister("/" + name.lstrip("/"), "shared_memory")
+    except Exception:  # noqa: BLE001 - tracker APIs are version-dependent
+        pass
+
+
+def encode_sample(sample):
+    """Sample → (shm_name, array descriptors, meta); arrays in one segment."""
+    img1, img2, flow, valid, meta = sample
+    arrays = [img1, img2, flow, valid]
+    total = sum(a.nbytes for a in arrays if a is not None)
+    shm = shared_memory.SharedMemory(create=True, size=max(1, total))
+    _unregister_tracker(shm.name)
+
+    descr = []
+    offset = 0
+    for a in arrays:
+        if a is None:
+            descr.append(None)
+            continue
+        a = np.ascontiguousarray(a)
+        dst = np.ndarray(a.shape, a.dtype, buffer=shm.buf, offset=offset)
+        dst[...] = a
+        descr.append((offset, a.shape, a.dtype))
+        offset += a.nbytes
+
+    name = shm.name
+    shm.close()
+    return name, descr, meta
+
+
+def decode_sample(payload):
+    """Payload → ((img1, img2, flow, valid, meta), shm handle).
+
+    The arrays are views into the segment: the caller must keep ``shm``
+    open until it has copied them out (collate does), then
+    ``shm.close(); shm.unlink()``.
+    """
+    name, descr, meta = payload
+    shm = shared_memory.SharedMemory(name=name)
+    arrays = []
+    for d in descr:
+        if d is None:
+            arrays.append(None)
+            continue
+        offset, shape, dtype = d
+        arrays.append(np.ndarray(shape, dtype, buffer=shm.buf, offset=offset))
+    img1, img2, flow, valid = arrays
+    return (img1, img2, flow, valid, meta), shm
+
+
+def _worker(source, tasks, results):
+    while True:
+        task = tasks.get()
+        if task is None:
+            return
+        seq, index = task
+        try:
+            results.put((seq, encode_sample(source[index]), None))
+        except BaseException as e:  # noqa: BLE001 - re-raised by consumer
+            try:
+                pickle.dumps(e)
+            except Exception:  # noqa: BLE001
+                e = RuntimeError(f"{type(e).__name__}: {e}")
+            results.put((seq, None, e))
+
+
+class DecodePool:
+    """Fixed pool of decode processes with in-order result retrieval."""
+
+    def __init__(self, source, procs, start_method=None):
+        method = start_method or os.environ.get("RMD_LOADER_MP", "fork")
+        ctx = mp.get_context(method)
+        self._tasks = ctx.Queue()
+        self._results = ctx.Queue()
+        self._received = {}
+        self._seq = 0
+        self._workers = [
+            ctx.Process(target=_worker, args=(source, self._tasks, self._results),
+                        daemon=True)
+            for _ in range(max(1, int(procs)))
+        ]
+        for w in self._workers:
+            w.start()
+
+    def submit(self, index):
+        """Queue one sample decode; returns its sequence token."""
+        seq = self._seq
+        self._seq += 1
+        self._tasks.put((seq, int(index)))
+        return seq
+
+    def result(self, seq):
+        """Block until sample ``seq`` is decoded; returns (sample, shm)."""
+        while seq not in self._received:
+            s, payload, err = self._results.get()
+            self._received[s] = (payload, err)
+        payload, err = self._received.pop(seq)
+        if err is not None:
+            raise err
+        return decode_sample(payload)
+
+    def shutdown(self):
+        for _ in self._workers:
+            self._tasks.put(None)
+        for w in self._workers:
+            w.join(timeout=5)
+            if w.is_alive():
+                w.terminate()
+        # drop any undelivered segments (consumer bailed mid-epoch)
+        for payload, err in self._received.values():
+            if payload is None:
+                continue
+            try:
+                shm = shared_memory.SharedMemory(name=payload[0])
+                shm.close()
+                shm.unlink()
+            except Exception:  # noqa: BLE001 - best-effort cleanup
+                pass
+        self._received.clear()
+        while True:
+            try:
+                s, payload, err = self._results.get_nowait()
+            except Exception:  # noqa: BLE001 - queue empty
+                break
+            if payload is not None:
+                try:
+                    shm = shared_memory.SharedMemory(name=payload[0])
+                    shm.close()
+                    shm.unlink()
+                except Exception:  # noqa: BLE001
+                    pass

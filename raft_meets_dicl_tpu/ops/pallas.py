@@ -27,6 +27,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed TPUCompilerParams -> CompilerParams around 0.5; support both
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+
 _TILE = 512
 _K = 9       # 3x3 neighbors
 _S = 64      # 8x8 subpixels
@@ -116,7 +120,7 @@ def _run_fwd(logits2d, win2d, inv_temp, interpret=False):
         ],
         out_specs=pl.BlockSpec((_TILE, _C * _S), lambda i: (i, 0),
                                memory_space=pltpu.VMEM),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=32 * 1024 * 1024),
         interpret=interpret,
     )(logits2d, win2d)
@@ -152,7 +156,7 @@ def _run_bwd(logits2d, win2d, dout2d, inv_temp, interpret=False):
         ),
         # f32 callers (the ctf family runs un-mixed) land just past the
         # 16M default with double buffering
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=32 * 1024 * 1024),
         interpret=interpret,
     )(logits2d, win2d, dout2d)
@@ -698,7 +702,7 @@ def _wcp_fwd_tpu(f1, f2_levels, coords, radius, interpret=False,
         out_specs=pl.BlockSpec((1, 1, n_jp, n_lvl * k, k),
                                lambda bi, ii: (bi, ii, 0, 0, 0),
                                memory_space=pltpu.VMEM),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )(coords, f1r, *f2p)
@@ -772,7 +776,7 @@ def _wcp_bwd_tpu(f1, f2_levels, coords, dout, radius, interpret=False,
             for f2 in f2p
         ],
         out_specs=row_spec,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )(coords, doutr, *f2p).reshape(b, n_i, n_jp, c)[:, :, :n_j]
@@ -795,7 +799,7 @@ def _wcp_bwd_tpu(f1, f2_levels, coords, dout, radius, interpret=False,
             out_specs=pl.BlockSpec((1,) + f2.shape[1:],
                                    lambda bi, ii: (bi, 0, 0, 0),
                                    memory_space=pltpu.VMEM),
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_CompilerParams(
                 vmem_limit_bytes=100 * 1024 * 1024),
             interpret=interpret,
         )(coords, f1r, dout_l)

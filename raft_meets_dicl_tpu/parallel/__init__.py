@@ -17,13 +17,14 @@ Axes:
 
 from .distributed import initialize, is_primary, process_count, process_index
 from .mesh import (
-    data_axis_size, data_mesh, replicate, set_data_axis_size, shard_batch,
+    batch_nbytes, data_axis_size, data_mesh, replicate, set_data_axis_size,
+    shard_batch,
 )
 from .train import TrainState, make_eval_step, make_train_step
 
 __all__ = [
-    "data_axis_size", "data_mesh", "replicate", "set_data_axis_size",
-    "shard_batch",
+    "batch_nbytes", "data_axis_size", "data_mesh", "replicate",
+    "set_data_axis_size", "shard_batch",
     "TrainState", "make_eval_step", "make_train_step",
     "initialize", "is_primary", "process_count", "process_index",
 ]

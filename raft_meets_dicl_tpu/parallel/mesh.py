@@ -60,6 +60,14 @@ def shard_batch(batch, mesh, axis_name="data"):
     return jax.tree.map(lambda x: jax.device_put(x, spec), batch)
 
 
+def batch_nbytes(batch):
+    """Total bytes of a (pytree) host batch — the wire volume one
+    ``shard_batch``/``device_put`` call moves across the host→device
+    boundary. Telemetry records this per step as ``wire_bytes``."""
+    return int(sum(x.nbytes for x in jax.tree.leaves(batch)
+                   if hasattr(x, "nbytes")))
+
+
 def replicate(tree, mesh):
     """Replicate a pytree (params, optimizer state) across the mesh."""
     spec = NamedSharding(mesh, P())

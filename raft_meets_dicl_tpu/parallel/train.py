@@ -65,7 +65,7 @@ class TrainState(struct.PyTreeNode):
 
 def make_train_step(model, loss_fn, tx, mesh=None, loss_args=None,
                     model_args=None, donate=True, external_lr=False,
-                    with_grads=False):
+                    with_grads=False, wire=None):
     """Build the jitted training step.
 
     Static per-stage configuration (``model_args``, ``loss_args``) is baked
@@ -84,11 +84,20 @@ def make_train_step(model, loss_fn, tx, mesh=None, loss_args=None,
     (gradient-statistics metrics). Off by default: returning grads keeps a
     second params-sized buffer alive past the optimizer update, defeating
     donation.
+
+    ``wire`` (a ``models.wire.WireFormat``) makes the step accept
+    wire-format batches: compact-dtype images that are dequantized and
+    clip/range-normalized on device, f16 flow, optionally bit-packed
+    valid masks. The host-side pipeline must then skip normalization
+    (``InputSpec.apply(..., normalize=False)``).
     """
     loss_args = dict(loss_args or {})
     model_args = dict(model_args or {})
 
     def step(state, lr, img1, img2, flow, valid):
+        if wire is not None:
+            img1, img2, flow, valid = wire.decode(img1, img2, flow, valid)
+
         def compute_loss(params):
             out, new_bs = model.apply(
                 {"params": params, "batch_stats": state.batch_stats},
@@ -156,11 +165,17 @@ def make_train_step(model, loss_fn, tx, mesh=None, loss_args=None,
         )))
 
 
-def make_eval_step(model, mesh=None, model_args=None):
-    """Build the jitted inference step returning the final flow."""
+def make_eval_step(model, mesh=None, model_args=None, wire=None):
+    """Build the jitted inference step returning the final flow.
+
+    ``wire`` decodes compact-dtype images on device (see
+    ``make_train_step``); flow/valid never cross into the eval step.
+    """
     model_args = dict(model_args or {})
 
     def step(variables, img1, img2):
+        if wire is not None:
+            img1, img2, _, _ = wire.decode(img1, img2)
         out = model.apply(variables, img1, img2, train=False, **model_args)
         result = model.get_adapter().wrap_result(out, img1.shape[1:3])
         return result.final()

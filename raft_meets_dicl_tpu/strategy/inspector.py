@@ -6,6 +6,12 @@ class Inspector:
     def setup(self, log, ctx):
         pass
 
+    def wants_host_images(self, step):
+        """Whether ``on_batch``/hooks will consume pixel values at this
+        step. Under a wire-format input pipeline the trainer only decodes
+        host images to normalized f32 when this returns True."""
+        return False
+
     def on_step_start(self, log, ctx, stage, epoch, i):
         pass
 

@@ -23,7 +23,9 @@ import jax
 import numpy as np
 
 from .. import telemetry, utils
-from ..parallel import TrainState, make_train_step, replicate, shard_batch
+from ..parallel import (
+    TrainState, batch_nbytes, make_train_step, replicate, shard_batch,
+)
 from .checkpoint import Checkpoint, Iteration, State
 from .spec import Stage, Strategy
 
@@ -94,10 +96,28 @@ class _StepResult:
         return [self.aux["final"]]
 
 
+def _make_put(base_put, wire, tele):
+    """Wrap the device-placement callable with wire encoding + accounting.
+
+    With ``wire`` the batch's flow/valid are compressed here (images come
+    wire-encoded from the adapter already) before ``base_put``; either way
+    the actual transfer volume is recorded as the per-step ``wire_bytes``
+    counter, so compression (or its absence) is visible in events.jsonl.
+    """
+
+    def put(batch):
+        if wire is not None:
+            batch = wire.encode_batch(batch)
+        tele.add_count("wire_bytes", batch_nbytes(batch))
+        return base_put(batch)
+
+    return put
+
+
 class TrainingContext:
     def __init__(self, log, path, strategy, model_id, model, model_adapter,
                  loss, input, inspector, checkpoints, mesh=None,
-                 step_limit=None, loader_args={}):
+                 step_limit=None, loader_args={}, wire=None):
         self.root_log = log
         self.log = log
         self.path = Path(path)
@@ -111,6 +131,11 @@ class TrainingContext:
         self.checkpoints = checkpoints
         self.mesh = mesh
         self.loader_args = dict(loader_args)
+        # wire format (models.wire.WireFormat) for the host→device batch
+        # transfer; bound to the input spec's clip/range per stage. None =
+        # legacy host-normalized f32 batches.
+        self.wire = (wire.bound(input.clip, input.range)
+                     if wire is not None else None)
 
         self.validate = True
 
@@ -286,7 +311,12 @@ class TrainingContext:
                 loader_args["seed"] = int(
                     multihost_utils.broadcast_one_to_all(np.int32(seed)))
 
-        input = self.input.apply(stage.data.source).jax()
+        if self.wire is not None:
+            log.info(f"wire format: {self.wire.describe()} "
+                     "(device-side normalization)")
+        input = self.input.apply(
+            stage.data.source, normalize=self.wire is None,
+        ).jax(wire=self.wire)
         self.data = input.loader(
             batch_size=batch_size,
             shuffle=stage.data.shuffle,
@@ -362,6 +392,7 @@ class TrainingContext:
             self.model, self.loss, self.tx, mesh=self.mesh,
             loss_args=stage.loss_args, model_args=stage.model_args,
             external_lr=True, donate=True, with_grads=with_grads,
+            wire=self.wire,
         )
 
         import os
@@ -426,11 +457,6 @@ class TrainingContext:
 
         base_put = ((lambda b: shard_batch(b, self.mesh))
                     if self.mesh is not None else jax.device_put)
-        # wire compression: when the model computes its encoders in bf16
-        # anyway (mixed-precision policy), transferring the normalized
-        # images as bf16 halves the dominant host->device bytes without
-        # changing the effective numerics (the first conv casts to bf16
-        # regardless); flow/valid stay exact. RMD_WIRE_BF16=0 opts out.
         import os as _os
 
         if _os.environ.get("RMD_PREFETCH_PUT", "1") == "0":
@@ -439,17 +465,25 @@ class TrainingContext:
             # device_put path misbehaves)
             base_put = lambda b: b  # noqa: E731
 
-        if (getattr(getattr(self.model, "module", None),
-                    "mixed_precision", False)
+        if (self.wire is None
+                and getattr(getattr(self.model, "module", None),
+                            "mixed_precision", False)
                 and _os.environ.get("RMD_WIRE_BF16", "1") != "0"):
+            # legacy lightweight compression (pre-wire-format): the model
+            # computes its encoders in bf16 anyway, so transferring the
+            # host-normalized images as bf16 halves the dominant bytes
+            # without changing effective numerics; flow/valid stay exact.
+            # The full wire layer (--wire-format) subsumes this path.
             import jax.numpy as jnp
 
             def put(b, _base=base_put):
                 img1, img2, flow, valid = b
-                return _base((np.asarray(img1, jnp.bfloat16),
-                              np.asarray(img2, jnp.bfloat16), flow, valid))
+                b = (np.asarray(img1, jnp.bfloat16),
+                     np.asarray(img2, jnp.bfloat16), flow, valid)
+                tele.add_count("wire_bytes", batch_nbytes(b))
+                return _base(b)
         else:
-            put = base_put
+            put = _make_put(base_put, self.wire, tele)
 
         for i, (host, dev, meta) in enumerate(
                 _device_prefetch(samples, put, tele=tele)):
@@ -493,6 +527,15 @@ class TrainingContext:
     def run_instance(self, log, stage, epoch, i, host, dev, meta):
         accumulate = stage.gradient.accumulate
         img1, img2, flow, valid = host
+
+        # wire mode: host images are un-normalized wire dtype. Observers
+        # that consume pixel values (TB image dumps, intermediates
+        # capture) expect the normalized f32 contract — decode on the
+        # steps where the inspector says it will actually look, so the
+        # hot path never pays the second f32 copy
+        if self.wire is not None and self._wants_host_images():
+            img1 = self.wire.decode_images_host(img1)
+            img2 = self.wire.decode_images_host(img2)
 
         if not self._in_step:
             self.inspector.on_step_start(log, self, stage, epoch, i)
@@ -587,6 +630,16 @@ class TrainingContext:
             self.inspector.on_step_end(log, self, stage, epoch, i)
             self.step += 1
             self._in_step = False
+
+    def _wants_host_images(self):
+        """Whether the inspector will consume pixel values this step.
+
+        Inspectors declare via ``wants_host_images(step)``; inspectors
+        that predate the wire layer get decoded images on every step
+        (correct, just not free).
+        """
+        fn = getattr(self.inspector, "wants_host_images", None)
+        return bool(fn(self.step)) if callable(fn) else True
 
     def _emit_device_sync(self, tele, drain):
         """Record one pipeline-drain sample: ``seconds`` is the time the

@@ -78,6 +78,10 @@ def validate_event(ev):
         if not isinstance(phases, dict) or not all(
                 isinstance(v, (int, float)) for v in phases.values()):
             raise ValueError(f"step phases must map name -> seconds: {ev!r}")
+        counters = ev.get("counters", {})
+        if not isinstance(counters, dict) or not all(
+                isinstance(v, (int, float)) for v in counters.values()):
+            raise ValueError(f"step counters must map name -> number: {ev!r}")
     if kind == "cache" and ev["event"] not in ("hit", "miss"):
         raise ValueError(f"cache event must be hit|miss: {ev!r}")
     return ev
@@ -103,6 +107,9 @@ class NullTelemetry:
         return contextlib.nullcontext()
 
     def add_phase(self, name, seconds):
+        pass
+
+    def add_count(self, name, value):
         pass
 
     def step_event(self, step, **fields):
@@ -135,6 +142,7 @@ class Telemetry:
         self._buffer = []
         self._fd = None
         self._phases = {}
+        self._step_counters = {}
         self._counts = {}
         self._last_step_t = None
         self._ema = None
@@ -200,13 +208,22 @@ class Telemetry:
         with self._lock:
             self._phases[name] = self._phases.get(name, 0.0) + seconds
 
+    def add_count(self, name, value):
+        """Per-step scalar counter (e.g. ``wire_bytes``, the host→device
+        transfer volume): accumulates like a phase and drains into the
+        next ``step`` event under ``counters``."""
+        with self._lock:
+            self._step_counters[name] = self._step_counters.get(name, 0) + value
+
     def step_event(self, step, **fields):
-        """Close out one optimizer step: drain accumulated phases, update
-        the throughput EMA, emit the ``step`` record."""
+        """Close out one optimizer step: drain accumulated phases and
+        counters, update the throughput EMA, emit the ``step`` record."""
         now = time.perf_counter()
         with self._lock:
             phases = self._phases
             self._phases = {}
+            counters = self._step_counters
+            self._step_counters = {}
         if self._last_step_t is None:
             step_time = sum(phases.values())
         else:
@@ -217,6 +234,8 @@ class Telemetry:
         self._ema = (inst if self._ema is None
                      else _EMA_ALPHA * inst + (1 - _EMA_ALPHA) * self._ema)
 
+        if counters:
+            fields = dict(fields, counters=counters)
         ev = self.emit(
             "step", step=step,
             phases={k: round(v, 6) for k, v in phases.items()},

@@ -87,6 +87,25 @@ def phase_stats(events):
     return out
 
 
+def counter_stats(events):
+    """Per-step scalar counters (``wire_bytes`` & co.) aggregated over
+    all step events: {name: {mean, max, total}}. Counters accumulate at
+    the producer's cadence (the prefetcher may attribute two puts to one
+    step event), so ``mean`` is total / number of steps — the per-step
+    average that survives the bunching."""
+    steps = [e for e in events if e["kind"] == "step"]
+    names = sorted({n for e in steps for n in e.get("counters", {})})
+    out = {}
+    for name in names:
+        vals = [e.get("counters", {}).get(name, 0) for e in steps]
+        out[name] = {
+            "mean": sum(vals) / len(vals),
+            "max": max(vals),
+            "total": sum(vals),
+        }
+    return out
+
+
 def device_step_time(events):
     """Mean device-pipeline seconds/step from the periodic sync samples.
 
@@ -196,6 +215,20 @@ def render(events, errors=(), warmup_steps=DEFAULT_WARMUP_STEPS,
             lines.append(
                 f"{name:<14} {_fmt_ms(s['mean'])} {_fmt_ms(s['p95'])} "
                 f"{_fmt_ms(s['max'])} {s['share'] * 100:6.1f}%")
+
+    counters = counter_stats(events)
+    if counters:
+        lines.append("")
+        lines.append("== step counters ==")
+        for name, s in counters.items():
+            if name.endswith("_bytes"):
+                lines.append(
+                    f"{name:<14} {s['mean'] / 2 ** 20:9.2f} MiB/step mean  "
+                    f"{s['total'] / 2 ** 20:9.2f} MiB total")
+            else:
+                lines.append(
+                    f"{name:<14} {s['mean']:9.2f}/step mean  "
+                    f"{s['total']:9.2f} total")
 
     dev = device_step_time(events)
     if dev:

