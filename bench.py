@@ -195,11 +195,134 @@ def _bench_input():
     return result
 
 
+def _bench_dicl():
+    """Matching-phase breakdown (``BENCH_DICL=1``): window-sample ms (XLA
+    gather vs fused Pallas sampler) and matching-net ms (per-level loop vs
+    level-batched) at the ml hybrid's 1/8-resolution matching shape, plus
+    the per-iteration matching-volume bytes each path moves. One JSON line
+    per measurement group (cumulative; consumers read the last line)."""
+    import jax
+    import jax.numpy as jnp
+
+    from raft_meets_dicl_tpu.models.common.corr.common import sample_window
+    from raft_meets_dicl_tpu.models.common.grid import coordinate_grid
+    from raft_meets_dicl_tpu.models.impls.raft_dicl_ml import (
+        MlCorrelationModule,
+    )
+    from raft_meets_dicl_tpu.ops.pallas import sample_window_fused
+
+    cpu = jax.default_backend() == "cpu"
+    if cpu:
+        batch, height, width, c, levels, radius, reps = 1, 64, 128, 8, 2, 2, 3
+    else:
+        batch = int(os.environ.get("BENCH_BATCH", "6"))
+        height = int(os.environ.get("BENCH_HEIGHT", "384"))
+        width = int(os.environ.get("BENCH_WIDTH", "704"))
+        c, levels, radius, reps = 32, 4, 4, 10
+    hc, wc = height // 8, width // 8
+
+    rng = np.random.RandomState(0)
+    fmap1 = tuple(jnp.asarray(rng.randn(batch, hc, wc, c), jnp.float32)
+                  for _ in range(levels))
+    fmap2 = tuple(
+        jnp.asarray(rng.randn(batch, hc // 2 ** i, wc // 2 ** i, c),
+                    jnp.float32)
+        for i in range(levels))
+    coords = coordinate_grid(batch, hc, wc) + jnp.asarray(
+        rng.randn(batch, hc, wc, 2) * 2, jnp.float32)
+
+    def timed(fn, *args):
+        f = jax.jit(fn)
+        float(f(*args))  # compile + sync (value transfer, see _measure)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = f(*args)
+        float(out)
+        return round((time.perf_counter() - t0) / reps * 1e3, 3)
+
+    result = {
+        "metric": "dicl-matching-breakdown",
+        "batch": batch, "height": height, "width": width,
+        "levels": levels, "radius": radius, "channels": c,
+        "backend": jax.default_backend(),
+    }
+
+    def sample_all(sampler, f2s):
+        return sum(
+            jnp.sum(sampler(f2, coords / 2 ** i, radius))
+            for i, f2 in enumerate(f2s))
+
+    def sample_all_grad(sampler, f2s):
+        return sum(jnp.sum(jnp.abs(g)) for g in jax.grad(
+            lambda fs: sample_all(sampler, fs))(f2s))
+
+    result["window_sample_ms"] = {
+        "xla": timed(lambda fs: sample_all(sample_window, fs), fmap2),
+        "fused": timed(lambda fs: sample_all(sample_window_fused, fs), fmap2),
+        "xla_fwd_bwd": timed(
+            lambda fs: sample_all_grad(sample_window, fs), fmap2),
+        "fused_fwd_bwd": timed(
+            lambda fs: sample_all_grad(sample_window_fused, fs), fmap2),
+    }
+    print(json.dumps(result), flush=True)
+
+    # matching nets: reference per-level loop vs the level-batched call,
+    # on identical parameters (bf16 matching like the mixed policy)
+    from raft_meets_dicl_tpu import telemetry
+    tele = telemetry.get()
+    for share in (False, True):
+        m = MlCorrelationModule(feature_dim=c, levels=levels, radius=radius,
+                                share=share, dtype=jnp.bfloat16)
+        v = m.init(jax.random.PRNGKey(0), fmap1, fmap2, coords)
+
+        def fwd(v, fast, m=m):
+            return jnp.sum(jnp.abs(m.apply(
+                v, fmap1, fmap2, coords, train=True, frozen_bn=True,
+                fast=fast)))
+
+        def fwd_bwd(v, fast, m=m):
+            return jax.grad(lambda p: fwd({**v, "params": p}, fast))(
+                v["params"])["MatchingNet_0"]["Conv_0"]["bias"].sum()
+
+        key = "shared" if share else "per_level_params"
+        result[f"matching_net_ms_{key}"] = {
+            "loop": timed(lambda vv: fwd(vv, False), v),
+            "batched": timed(lambda vv: fwd(vv, True), v),
+            "loop_fwd_bwd": timed(lambda vv: fwd_bwd(vv, False), v),
+            "batched_fwd_bwd": timed(lambda vv: fwd_bwd(vv, True), v),
+        }
+        print(json.dumps(result), flush=True)
+
+    # per-iteration matching-volume bytes (bf16 fast path vs f32 stacked
+    # reference): window + f1 in matching dtype vs the 2C stacked volume
+    win = batch * (2 * radius + 1) ** 2 * hc * wc * c
+    f1b = batch * hc * wc * c
+    result["matching_volume_bytes"] = {
+        "fast_bf16_unstacked": levels * (win + f1b) * 2,
+        "reference_f32_stacked": levels * 2 * win * 4,
+    }
+    if tele.enabled:
+        result["telemetry_events"] = tele.counts()
+    print(json.dumps(result), flush=True)
+    return result
+
+
 def main():
     if os.environ.get("BENCH_INPUT", "0") != "0":
         # input-pipeline-only mode: host-side decode/collate/wire-volume
         # numbers, no device required
         _bench_input()
+        return
+
+    if os.environ.get("BENCH_DICL", "0") != "0":
+        # matching-phase microbench for the DICL-hybrid fast path
+        from raft_meets_dicl_tpu.utils.compcache import (
+            enable_persistent_cache,
+        )
+        enable_persistent_cache()
+        from raft_meets_dicl_tpu import telemetry
+        telemetry.activate(telemetry.create())
+        _bench_dicl()
         return
 
     # persistent compile cache: cold zoo compiles total ~40 min and have

@@ -6,62 +6,56 @@ siblings); here one helper owns that lookup, built on the framework's
 bilinear-sample op, with windows ordered by ``ops.corr.window_delta``
 (axis 0 varies dx) so every cost volume in the framework shares one channel
 layout.
+
+The XLA sampler lives in ``ops.sample.sample_window`` (re-exported here
+for the corr modules and parity tests); ``sample_window_fast`` dispatches
+to the fused Pallas kernel on TPU unless the ``RMD_DICL_FAST=0`` escape
+hatch forces the reference path.
 """
+
+import os
 
 import flax.linen as nn
 import jax.numpy as jnp
 
 from ....ops.corr import window_delta
+from ....ops.sample import sample_window  # noqa: F401  (re-export)
 from ..blocks.dicl import DisplacementAwareProjection
 
 
-def sample_window(f2, coords, radius):
-    """Sample f2 at the (2r+1)² displaced positions around each coordinate.
+def dicl_fast_enabled():
+    """DICL fast-path switch, read at trace time: ``RMD_DICL_FAST=0``
+    restores the reference XLA sampler + per-level matching loops."""
+    return os.environ.get("RMD_DICL_FAST", "1") != "0"
 
-    f2: (B, H2, W2, C) features; coords: (B, H, W, 2) pixel positions *into
-    f2's grid* — the two resolutions may differ (multi-level lookups pass
-    coarser feature maps with rescaled coordinates). Returns
-    (B, du, dv, H, W, C) with zero padding outside — du varies dx.
 
-    All (2r+1)² displacements are integer offsets from one center, so they
-    share the center's bilinear fractions: instead of 4 corner gathers per
-    displacement (4K² rows per position through ``sample_bilinear``), one
-    (K+1)² integer patch is gathered per position and the displaced values
-    come from two static-shift lerps over the patch — 3.2x fewer gather
-    rows, the dominant cost of the DICL models' training step. Zero padding
-    falls out of masking OOB patch entries (every sampled value is a convex
-    combination of patch entries, exactly the grid_sample corner terms).
+def sample_window_fast(f2, coords, radius):
+    """``sample_window`` through the fused Pallas kernel when enabled.
+
+    Semantics and layout match ``sample_window`` exactly; the fused path
+    treats ``coords`` as non-differentiable (every caller sits behind the
+    RAFT iteration's stop_gradient on the lookup centers).
     """
-    b, h, w = coords.shape[:3]
-    h2, w2, c = f2.shape[-3:]
-    k = 2 * radius + 1
-    t = k + 1
+    if not dicl_fast_enabled():
+        return sample_window(f2, coords, radius)
+    from ....ops.pallas import sample_window_fused
 
-    # patch base = top-left corner of the displacement window
-    cx = coords[..., 0].reshape(b, -1) - radius      # (B, P)
-    cy = coords[..., 1].reshape(b, -1) - radius
-    x0f = jnp.floor(cx)
-    y0f = jnp.floor(cy)
-    fx = (cx - x0f)[:, None, None, :, None]          # (B, 1, 1, P, 1)
-    fy = (cy - y0f)[:, None, None, :, None]
+    return sample_window_fused(f2, coords, radius)
 
-    # tap axes ordered (tx, ty) so the lerped output is (dx, dy)-major,
-    # matching window_delta's du-varies-dx channel layout
-    tx = jnp.arange(t, dtype=jnp.int32)[None, :, None, None]
-    ty = jnp.arange(t, dtype=jnp.int32)[None, None, :, None]
-    ix = x0f.astype(jnp.int32)[:, None, None, :] + tx   # (B, T, T, P)
-    iy = y0f.astype(jnp.int32)[:, None, None, :] + ty
-    inb = (ix >= 0) & (ix <= w2 - 1) & (iy >= 0) & (iy <= h2 - 1)
-    idx = (jnp.clip(iy, 0, h2 - 1) * w2 + jnp.clip(ix, 0, w2 - 1))
 
-    flat = f2.reshape(b, h2 * w2, c)
-    patch = jnp.take_along_axis(flat, idx.reshape(b, -1)[..., None], axis=1)
-    patch = patch.reshape(b, t, t, h * w, c) * inb[..., None]
+def record_matching_bytes(*arrays):
+    """Trace-time accounting of the matching volumes fed to the cost nets.
 
-    # separable lerp over the shared fractions (static shifts only)
-    ylerp = (1.0 - fy) * patch[:, :, 0:k] + fy * patch[:, :, 1:t]
-    win = (1.0 - fx) * ylerp[:, 0:k] + fx * ylerp[:, 1:t]
-    return win.reshape(b, k, k, h, w, c)
+    Called while the model traces (once per compile): the byte count lands
+    in the next ``step`` event's counters as ``matching_volume_bytes``, so
+    events.jsonl shows the window/volume footprint the matching path moves
+    per step — and the drop when the unstacked/bf16 fast path is active.
+    """
+    from .... import telemetry
+
+    n = sum(int(a.size) * a.dtype.itemsize for a in arrays)
+    telemetry.get().add_count("matching_volume_bytes", n)
+    return n
 
 
 def stack_pair(f1, f2_window):

@@ -15,7 +15,8 @@ from ..blocks.dicl import DisplacementAwareProjection, MatchingNet
 from .common import (
     SoftArgMaxFlowRegression,
     SoftArgMaxFlowRegressionWithDap,
-    sample_window,
+    record_matching_bytes,
+    sample_window_fast,
 )
 
 __all__ = ["CorrelationModule", "SoftArgMaxFlowRegression",
@@ -38,7 +39,7 @@ class CorrelationModule(nn.Module):
     def __call__(self, f1, f2, coords, dap=True, train=False, frozen_bn=False):
         b, h, w, _ = f1.shape
 
-        window = sample_window(f2, coords, self.radius)
+        window = sample_window_fast(f2, coords, self.radius)
         # unstacked pair: MatchingNet's first conv computes the f1 half
         # once and broadcasts it over the (2r+1)² displacements — the
         # (B, du, dv, H, W, 2C) stacked volume's f1 copies never exist
@@ -47,6 +48,8 @@ class CorrelationModule(nn.Module):
         if self.dtype is not None:
             f1 = f1.astype(self.dtype)
             window = window.astype(self.dtype)
+        if not self.is_initializing():
+            record_matching_bytes(f1, window)
 
         cost = MatchingNet(norm_type=self.norm_type, scale=self.mnet_scale,
                            dtype=self.dtype)(

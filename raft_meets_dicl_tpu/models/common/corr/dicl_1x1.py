@@ -3,16 +3,24 @@
 Behavioral equivalent of reference src/models/common/corr/dicl_1x1.py: same
 lookup as the full DICL module but the cost net is three 1x1 conv blocks +
 a biased 1x1 head — per-pixel cost, no spatial context.
+
+Runs the unstacked ``(f1, window)`` matching form (the f1 half of the
+first conv computes once instead of per displacement, and the stacked
+(B, du, dv, H, W, 2C) volume never materializes); ``stack_pair`` remains
+the parity reference for tests.
 """
 
+from typing import Any
+
 import flax.linen as nn
+import jax.numpy as jnp
 
 from ..blocks.dicl import ConvBlock, DisplacementAwareProjection
 from .common import (
     SoftArgMaxFlowRegression,
     SoftArgMaxFlowRegressionWithDap,
-    sample_window,
-    stack_pair,
+    record_matching_bytes,
+    sample_window_fast,
 )
 
 __all__ = ["CorrelationModule", "MatchingNet1x1", "SoftArgMaxFlowRegression",
@@ -21,26 +29,45 @@ __all__ = ["CorrelationModule", "MatchingNet1x1", "SoftArgMaxFlowRegression",
 
 class MatchingNet1x1(nn.Module):
     """Pointwise matching net (reference dicl_1x1.py:8-30): displacement
-    axes ride the batch through 1x1 convs."""
+    axes ride the batch through 1x1 convs.
+
+    Input is the stacked ``(B, du, dv, H, W, 2C)`` volume or the unstacked
+    pair ``(f1, window)`` — the first conv then splits along its input
+    channels exactly like ``MatchingNet`` (parameters identical to the
+    stacked form, f1-first channel order).
+    """
 
     norm_type: str = "batch"
     scale: float = 1
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, mvol, train=False, frozen_bn=False):
-        b, du, dv, h, w, c = mvol.shape
+        dt = self.dtype
         c1 = int(self.scale * 96)
         c2 = int(self.scale * 128)
         c3 = int(self.scale * 64)
 
-        x = mvol.reshape(b * du * dv, h, w, c)
+        if isinstance(mvol, tuple):
+            f1, window = mvol
+            b, du, dv, h, w, c = window.shape
+            x = ConvBlock(c1, kernel_size=1, norm_type=self.norm_type,
+                          dtype=dt)(
+                (f1, window.reshape(b * du * dv, h, w, c)), train, frozen_bn)
+        else:
+            b, du, dv, h, w, c = mvol.shape
+            x = mvol.reshape(b * du * dv, h, w, c)
+            x = ConvBlock(c1, kernel_size=1, norm_type=self.norm_type,
+                          dtype=dt)(x, train, frozen_bn)
 
-        x = ConvBlock(c1, kernel_size=1, norm_type=self.norm_type)(x, train, frozen_bn)
-        x = ConvBlock(c2, kernel_size=1, norm_type=self.norm_type)(x, train, frozen_bn)
-        x = ConvBlock(c3, kernel_size=1, norm_type=self.norm_type)(x, train, frozen_bn)
-        x = nn.Conv(1, (1, 1))(x)  # with bias, like the reference
+        x = ConvBlock(c2, kernel_size=1, norm_type=self.norm_type, dtype=dt)(
+            x, train, frozen_bn)
+        x = ConvBlock(c3, kernel_size=1, norm_type=self.norm_type, dtype=dt)(
+            x, train, frozen_bn)
+        x = nn.Conv(1, (1, 1), dtype=dt)(x)  # with bias, like the reference
 
-        cost = x.reshape(b, du, dv, h, w)
+        # the cost volume is the readout surface (softargmax/DAP): f32
+        cost = x.reshape(b, du, dv, h, w).astype(jnp.float32)
         return cost.transpose(0, 3, 4, 1, 2)  # (B, H, W, du, dv)
 
 
@@ -50,6 +77,7 @@ class CorrelationModule(nn.Module):
     dap_init: str = "identity"
     norm_type: str = "batch"
     mnet_scale: float = 1
+    dtype: Any = None
 
     @property
     def output_dim(self):
@@ -59,11 +87,16 @@ class CorrelationModule(nn.Module):
     def __call__(self, f1, f2, coords, dap=True, train=False, frozen_bn=False):
         b, h, w, _ = f1.shape
 
-        window = sample_window(f2, coords, self.radius)
-        mvol = stack_pair(f1, window)
+        window = sample_window_fast(f2, coords, self.radius)
+        if self.dtype is not None:
+            f1 = f1.astype(self.dtype)
+            window = window.astype(self.dtype)
+        if not self.is_initializing():
+            record_matching_bytes(f1, window)
 
-        cost = MatchingNet1x1(norm_type=self.norm_type, scale=self.mnet_scale)(
-            mvol, train, frozen_bn
+        cost = MatchingNet1x1(norm_type=self.norm_type, scale=self.mnet_scale,
+                              dtype=self.dtype)(
+            (f1, window), train, frozen_bn
         )
 
         if dap:

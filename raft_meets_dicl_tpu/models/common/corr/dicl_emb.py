@@ -5,14 +5,28 @@ matching volume gains the window offsets as positional-encoding channels, a
 pointwise pair-embedding net produces per-displacement embeddings, and the
 (DAP-weighted) cost softmax attends over them — the module outputs the cost
 volume concatenated with the attended embedding.
+
+Both nets consume the unstacked ``(f1, window ++ delta)`` pair: their first
+convs split along the input channels (f1 half computed once, broadcast over
+displacements), so the stacked (B, du, dv, H, W, 2C+2) volume's f1 copies
+never materialize. Parameters are identical to the stacked form
+(``stack_pair`` remains the parity reference for tests).
 """
 
+from typing import Any
+
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from ....ops.corr import window_delta
 from ..blocks.dicl import DisplacementAwareProjection, MatchingNet
-from .common import soft_argmax_flow, sample_window, stack_pair
+from ..util import ConvParams
+from .common import (
+    record_matching_bytes,
+    sample_window_fast,
+    soft_argmax_flow,
+)
 
 __all__ = ["CorrelationModule", "PairEmbedding", "SoftArgMaxFlowRegression",
            "SoftArgMaxFlowRegressionWithDap"]
@@ -20,19 +34,52 @@ __all__ = ["CorrelationModule", "PairEmbedding", "SoftArgMaxFlowRegression",
 
 class PairEmbedding(nn.Module):
     """Pointwise embedding of stacked feature pairs
-    (reference dicl_emb.py:8-29)."""
+    (reference dicl_emb.py:8-29).
+
+    Accepts the stacked ``(B, du, dv, H, W, C)`` volume or the unstacked
+    ``(shared, per_item)`` pair — the first conv then splits along its
+    input channels by linearity (shared-first kernel order, parameters
+    identical to the stacked form).
+    """
 
     output_dim: int = 32
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, fstack):
-        b, du, dv, h, w, c = fstack.shape
+        if isinstance(fstack, tuple):
+            shared, per_item = fstack
+            b, du, dv, h, w, c = per_item.shape
+            x = per_item.reshape(b * du * dv, h, w, c)
 
-        x = fstack.reshape(b * du * dv, h, w, c)
-        x = nn.relu(nn.Conv(48, (1, 1))(x))
-        x = nn.relu(nn.Conv(64, (1, 1))(x))
-        x = nn.Conv(self.output_dim, (1, 1))(x)
+            c1 = shared.shape[-1]
+            kernel, bias = ConvParams(48, (1, 1), name="Conv_0")(c1 + c)
+            dt = self.dtype or kernel.dtype
 
+            def conv(inp, kk):
+                return jax.lax.conv_general_dilated(
+                    inp.astype(dt), kk.astype(dt), (1, 1),
+                    [(0, 0), (0, 0)],
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+            ys = conv(shared, kernel[:, :, :c1])       # (B, H, W, 48)
+            yp = conv(x, kernel[:, :, c1:])            # (B·N, H, W, 48)
+            n = yp.shape[0] // ys.shape[0]
+            x = (yp.reshape(ys.shape[0], n, *yp.shape[1:])
+                 + ys[:, None]).reshape(yp.shape)
+            x = nn.relu(x + bias.astype(dt))
+        else:
+            b, du, dv, h, w, c = fstack.shape
+            x = fstack.reshape(b * du * dv, h, w, c)
+            x = nn.relu(nn.Conv(48, (1, 1), dtype=self.dtype,
+                                name="Conv_0")(x))
+
+        x = nn.relu(nn.Conv(64, (1, 1), dtype=self.dtype, name="Conv_1")(x))
+        x = nn.Conv(self.output_dim, (1, 1), dtype=self.dtype,
+                    name="Conv_2")(x)
+
+        # embeddings feed the (f32) attention readout
+        x = x.astype(jnp.float32)
         return x.reshape(b, du, dv, h, w, self.output_dim)
 
 
@@ -42,6 +89,7 @@ class CorrelationModule(nn.Module):
     embedding_dim: int = 32
     dap_init: str = "identity"
     norm_type: str = "batch"
+    dtype: Any = None
 
     @property
     def output_dim(self):
@@ -52,18 +100,27 @@ class CorrelationModule(nn.Module):
         b, h, w, _ = f1.shape
         k = 2 * self.radius + 1
 
-        window = sample_window(f2, coords, self.radius)
-        mvol = stack_pair(f1, window)  # (B, du, dv, H, W, 2C)
+        window = sample_window_fast(f2, coords, self.radius)
 
-        # window offsets as positional encodings (dicl_emb.py:78-83)
-        delta = window_delta(self.radius, mvol.dtype)  # (K, K, 2)
+        # window offsets as positional encodings (dicl_emb.py:78-83),
+        # riding the per-displacement half of the unstacked pair so the
+        # kernel channel order matches the stacked [f1 | window | delta]
+        delta = window_delta(self.radius, window.dtype)  # (K, K, 2)
         delta = jnp.broadcast_to(
             delta[None, :, :, None, None, :], (b, k, k, h, w, 2)
         )
-        mvol = jnp.concatenate((mvol, delta), axis=-1)
+        if self.dtype is not None:
+            f1 = f1.astype(self.dtype)
+            window = window.astype(self.dtype)
+            delta = delta.astype(self.dtype)
+        per_item = jnp.concatenate((window, delta), axis=-1)
+        if not self.is_initializing():
+            record_matching_bytes(f1, per_item)
 
-        cost = MatchingNet(norm_type=self.norm_type)(mvol, train, frozen_bn)
-        emb = PairEmbedding(self.embedding_dim)(mvol)  # (B, du, dv, H, W, E)
+        cost = MatchingNet(norm_type=self.norm_type, dtype=self.dtype)(
+            (f1, per_item), train, frozen_bn)
+        emb = PairEmbedding(self.embedding_dim, dtype=self.dtype)(
+            (f1, per_item))  # (B, du, dv, H, W, E)
 
         score = cost
         if dap:

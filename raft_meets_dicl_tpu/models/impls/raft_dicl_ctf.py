@@ -120,7 +120,10 @@ class RaftPlusDiclCtfModule(nn.Module):
 
     def _make_cmod(self, dtype=None):
         kwargs = dict(self.corr_args or {})
-        if dtype is not None and self.corr_type == "dicl":
+        # the matching-net cmods all take a compute dtype now; "dot" has
+        # no net to cast (its einsum accumulates f32 regardless)
+        if dtype is not None and self.corr_type in ("dicl", "dicl-1x1",
+                                                    "dicl-emb"):
             kwargs["dtype"] = dtype
         return corr_mod.make_cmod(
             self.corr_type, self.corr_channels, radius=self.corr_radius,

@@ -19,7 +19,12 @@ from ...ops.pool import avg_pool2d, max_pool2d
 from ...ops.upsample import interpolate_bilinear
 from ..common.blocks.dicl import DisplacementAwareProjection, MatchingNet
 from ..common.blocks.raft import ResidualBlock, kaiming_normal
-from ..common.corr.common import sample_window, stack_pair
+from ..common.corr.common import (
+    dicl_fast_enabled,
+    record_matching_bytes,
+    sample_window,
+    sample_window_fast,
+)
 from ..common.encoders.raft import FeatureEncoderS3
 from ..common.grid import coordinate_grid
 from ..common.norm import Norm2d
@@ -91,7 +96,25 @@ class PyramidEncoder(nn.Module):
 
 class MlCorrelationModule(nn.Module):
     """Fused multi-level DICL lookup around one 1/8 flow estimate
-    (reference raft_dicl_ml.py:236-345)."""
+    (reference raft_dicl_ml.py:236-345).
+
+    Matching runs through the shared fast path by default: the fused
+    window sampler, the unstacked ``(f1, window)`` MatchingNet form (the
+    stacked (B, du, dv, H, W, 2C) volume never materializes), matching in
+    ``dtype`` when set, and ONE batched MatchingNet evaluation per GRU
+    iteration instead of a python loop of ``levels`` hourglass calls —
+    all levels share the 1/8 output resolution and channel count, so they
+    concatenate along the batch when ``share=True`` and ride a
+    stacked-params ``vmap`` when ``share=False``. Parameter paths and
+    checkpoints are unchanged: the per-level modules below own the
+    parameters in both paths; the vmap only *reads* their subtrees.
+
+    The reference per-level loop remains the fallback (``fast=False``,
+    the ``RMD_DICL_FAST=0`` escape hatch, initialization, live-BN
+    training — whose sequential running-stat updates the batched call
+    cannot reproduce — and, for ``share=False``, non-TPU backends by
+    default, where CPU XLA's grouped-conv backward is pathological).
+    """
 
     feature_dim: int
     levels: int
@@ -100,39 +123,67 @@ class MlCorrelationModule(nn.Module):
     dap_type: str = "separate"
     norm_type: str = "batch"
     share: bool = False
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, fmap1, fmap2, coords, dap=True, mask_costs=(),
-                 train=False, frozen_bn=False):
+                 train=False, frozen_bn=False, fast=None):
         if self.dap_type not in ("full", "separate"):
             raise ValueError(f"DAP type '{self.dap_type}' not supported")
 
         b, h, w, _ = coords.shape
         k = 2 * self.radius + 1
 
+        if fast is None:
+            # share=False batches via stacked-params vmap → grouped convs,
+            # whose backward is pathological on CPU XLA (~6x the loop) but
+            # MXU-native on TPU: off-TPU the default stays on the loop
+            # (explicit fast=True still forces the batched path)
+            fast = dicl_fast_enabled() and (
+                self.share or jax.default_backend() == "tpu")
+        # live batch norm computes per-level statistics sequentially (the
+        # shared-params case updates running stats levels-times per call);
+        # only the reference loop reproduces that
+        live_bn = train and not frozen_bn and self.norm_type == "batch"
+        fast = fast and not live_bn and not self.is_initializing()
+
         if self.share:
-            shared_mnet = MatchingNet(norm_type=self.norm_type)
+            shared_mnet = MatchingNet(norm_type=self.norm_type,
+                                      dtype=self.dtype)
             mnets = [shared_mnet] * self.levels
             if self.dap_type == "separate":
                 shared_dap = DisplacementAwareProjection(
                     (self.radius, self.radius), init=self.dap_init)
                 daps = [shared_dap] * self.levels
         else:
-            mnets = [MatchingNet(norm_type=self.norm_type)
+            mnets = [MatchingNet(norm_type=self.norm_type, dtype=self.dtype)
                      for _ in range(self.levels)]
             if self.dap_type == "separate":
                 daps = [DisplacementAwareProjection(
                             (self.radius, self.radius), init=self.dap_init)
                         for _ in range(self.levels)]
 
+        sample = sample_window_fast if fast else sample_window
+        windows = [sample(f2, coords / 2 ** i, self.radius)
+                   for i, f2 in enumerate(fmap2)]
+        fmap1 = list(fmap1)
+        if self.dtype is not None:
+            fmap1 = [f1.astype(self.dtype) for f1 in fmap1]
+            windows = [win.astype(self.dtype) for win in windows]
+        if not self.is_initializing():
+            record_matching_bytes(*fmap1, *windows)
+
+        if fast:
+            costs = self._batched_costs(mnets, fmap1, windows, train,
+                                        frozen_bn)
+        else:
+            # reference per-level loop (also the init path: creates the
+            # per-level parameters at their checkpoint paths)
+            costs = [mnets[i]((f1, win), train, frozen_bn)
+                     for i, (f1, win) in enumerate(zip(fmap1, windows))]
+
         out = []
-        for i, (f1, f2) in enumerate(zip(fmap1, fmap2)):
-            window = sample_window(f2, coords / 2 ** i, self.radius)
-            # the stack features stay at 1/8: broadcast f1 over the window
-            mvol = stack_pair(f1, window)
-
-            cost = mnets[i](mvol, train, frozen_bn)  # (B, H, W, du, dv)
-
+        for i, cost in enumerate(costs):       # cost: (B, H, W, du, dv)
             if i + 3 in mask_costs:
                 cost = jnp.zeros_like(cost)
 
@@ -155,6 +206,46 @@ class MlCorrelationModule(nn.Module):
                 out = projected
 
         return out
+
+    def _batched_costs(self, mnets, fmap1, windows, train, frozen_bn):
+        """One MatchingNet evaluation for all levels.
+
+        ``share=True``: the levels concatenate along the batch axis into
+        the single shared net — identical parameters, identical per-element
+        math (norms are frozen/stat-free on this path).
+
+        ``share=False``: the per-level parameter subtrees created by the
+        reference loop are read from this module's scope, stacked along a
+        level axis, and the net runs under ``jax.vmap`` — XLA sees one
+        grouped convolution per layer instead of ``levels`` separate
+        hourglasses, while the checkpoint keeps its per-level
+        ``MatchingNet_i`` layout (the stacking is a trace-time view).
+        """
+        if self.share:
+            f1a = jnp.concatenate(fmap1, axis=0)
+            wina = jnp.concatenate(windows, axis=0)
+            cost = mnets[0]((f1a, wina), train, frozen_bn)  # (L·B, H, W, k, k)
+            return [cost[i * fmap1[0].shape[0]:(i + 1) * fmap1[0].shape[0]]
+                    for i in range(self.levels)]
+
+        variables = []
+        for i in range(self.levels):
+            vs = {"params": self.scope.get_variable(
+                "params", f"MatchingNet_{i}")}
+            if self.has_variable("batch_stats", f"MatchingNet_{i}"):
+                vs["batch_stats"] = self.scope.get_variable(
+                    "batch_stats", f"MatchingNet_{i}")
+            variables.append(vs)
+        stacked = jax.tree_util.tree_map(lambda *a: jnp.stack(a), *variables)
+
+        template = MatchingNet(norm_type=self.norm_type, dtype=self.dtype,
+                               parent=None)
+
+        def one(vs, f1, win):
+            return template.apply(vs, (f1, win), train, frozen_bn)
+
+        costs = jax.vmap(one)(stacked, jnp.stack(fmap1), jnp.stack(windows))
+        return [costs[i] for i in range(self.levels)]
 
 
 class _MlStep(nn.Module):
@@ -267,11 +358,13 @@ class RaftPlusDiclMlModule(nn.Module):
         coords0 = coordinate_grid(b, hc, wc)
         coords1 = coords0 + flow_init if flow_init is not None else coords0
 
+        # the matching nets follow the model's mixed policy (the reference
+        # autocast covers them too; cost volumes come back f32 regardless)
         cvol = MlCorrelationModule(
             feature_dim=self.corr_channels, levels=self.corr_levels,
             radius=self.corr_radius, dap_init=self.dap_init,
             dap_type=self.dap_type, norm_type=self.mnet_norm,
-            share=self.share_dicl,
+            share=self.share_dicl, dtype=dt,
         )
         reg = make_flow_regression(self.corr_reg_type, self.corr_levels,
                                    self.corr_radius,

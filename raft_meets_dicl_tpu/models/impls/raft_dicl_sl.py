@@ -80,10 +80,16 @@ class RaftPlusDiclModule(nn.Module):
         coords0 = coordinate_grid(b, hc, wc)
         coords1 = coords0 + flow_init if flow_init is not None else coords0
 
+        corr_args = dict(self.corr_args or {})
+        # matching nets follow the mixed policy (cost comes back f32);
+        # "dot" has no net to cast
+        if dt is not None and self.corr_type in ("dicl", "dicl-1x1",
+                                                 "dicl-emb"):
+            corr_args.setdefault("dtype", dt)
         cvol = corr_mod.make_cmod(
             self.corr_type, self.corr_channels, radius=self.corr_radius,
             dap_init=self.dap_init, norm_type=self.mnet_norm,
-            **(self.corr_args or {}),
+            **corr_args,
         )
         # always created (and called in the step) so a '+dap' readout's
         # params exist regardless of the static corr_flow switch

@@ -910,6 +910,232 @@ def windowed_corr_pyramid(f1, f2_levels, coords, radius=4, mask_costs=(),
     return out
 
 
+# ---------------------------------------------------------------------------
+# Fused DICL window sampler.
+#
+# The DICL-family matching path samples the full (2r+1)² displaced feature
+# window per position (``ops.sample.sample_window``) — not a dot-product
+# readout like the windowed correlation above, but the raw (k, k, C) window
+# the MatchingNet then convolves. The XLA form gathers one (k+1)² integer
+# patch per position through HBM (a giant take_along_axis) and materializes
+# it before the two lerps; this kernel reuses the proven 8-aligned-slab
+# machinery of the windowed correlation (``_wcp_window`` / ``_x_select`` /
+# ``_wcp_pads``) to keep the patch and both separable lerps in VMEM: per
+# position it reads one (k+1, _XW, C) slab, lerps y as a static row pair,
+# resolves x per static dx via the arithmetic lane-selection matrix, and
+# writes the (k², C) window row — nothing patch-sized ever touches HBM.
+#
+# The custom VJP accumulates the window gradient back into the padded f2
+# map (transpose of the two lerps), mirroring ``_wcp_bwd_df2_kernel``.
+# Coordinates get a zero gradient: every caller (the corr modules inside
+# the RAFT iteration) stop-gradients the lookup centers, exactly like the
+# windowed-correlation kernel's contract.
+
+
+def _sw_fwd_kernel(coords_ref, f2_ref, out_ref, *, radius, dims):
+    k = 2 * radius + 1
+    h2, w2 = dims
+    n_j = out_ref.shape[2]
+
+    def body(j, _):
+        cx = coords_ref[0, 0, j, 0]
+        cy = coords_ref[0, 0, j, 1]
+        x8, s, y0, fx, fy = _wcp_window(cx, cy, 0, h2, w2, radius)
+
+        slab = f2_ref[0, pl.ds(y0, k + 1), pl.ds(x8, _XW), :]
+        slab = slab.astype(jnp.float32)                 # (k+1, _XW, C)
+        t = (1.0 - fy) * slab[0:k] + fy * slab[1:k + 1]  # (k_dy, _XW, C)
+        m = _x_select(s, fx, k)                          # (_XW, k_dx)
+
+        # dx-major rows: column dx of m lerps lanes s+dx / s+dx+1
+        rows = [
+            jnp.sum(t * m[None, :, dx:dx + 1], axis=1)   # (k_dy, C)
+            for dx in range(k)
+        ]
+        out_ref[0, 0, j] = jnp.concatenate(rows, axis=0)  # (k², C) (dx, dy)
+        return 0
+
+    jax.lax.fori_loop(0, n_j, body, 0)
+
+
+def _sw_bwd_kernel(coords_ref, dout_ref, df2_ref, *, radius, dims):
+    """df2 accumulated across the i-grid (the padded output block is
+    indexed by b only and stays resident in VMEM, like
+    ``_wcp_bwd_df2_kernel``)."""
+    k = 2 * radius + 1
+    h2, w2 = dims
+    n_j = dout_ref.shape[2]
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _():
+        df2_ref[:] = jnp.zeros_like(df2_ref)
+
+    def body(j, _):
+        cx = coords_ref[0, 0, j, 0]
+        cy = coords_ref[0, 0, j, 1]
+        x8, s, y0, fx, fy = _wcp_window(cx, cy, 0, h2, w2, radius)
+        m = _x_select(s, fx, k)                          # (_XW, k_dx)
+
+        dv = dout_ref[0, 0, j].astype(jnp.float32)       # (k², C) (dx, dy)
+        # transpose of the x-selection: spread each dx row block over lanes
+        dt = None
+        for dx in range(k):
+            part = (dv[dx * k:(dx + 1) * k][:, None, :]
+                    * m[None, :, dx:dx + 1])             # (k_dy, _XW, C)
+            dt = part if dt is None else dt + part
+        zr = jnp.zeros((1, _XW, dt.shape[-1]), jnp.float32)
+        dd = ((1.0 - fy) * jnp.concatenate([dt, zr], axis=0)
+              + fy * jnp.concatenate([zr, dt], axis=0))  # (k+1, _XW, C)
+
+        df2_ref[0, pl.ds(y0, k + 1), pl.ds(x8, _XW), :] += dd
+        return 0
+
+    jax.lax.fori_loop(0, n_j, body, 0)
+
+
+def _sw_fwd_tpu(f2, coords, radius, interpret=False):
+    b, n_i, n_j = coords.shape[:3]
+    c = f2.shape[-1]
+    k = 2 * radius + 1
+    dims = (f2.shape[1], f2.shape[2])
+    (f2p,) = _wcp_pad_f2((f2,), radius)
+
+    out = pl.pallas_call(
+        functools.partial(_sw_fwd_kernel, radius=radius, dims=dims),
+        out_shape=jax.ShapeDtypeStruct((b, n_i, n_j, k * k, c),
+                                       jnp.float32),
+        grid=(b, n_i),
+        in_specs=[
+            pl.BlockSpec((1, 1, n_j, 2), lambda bi, ii: (bi, ii, 0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1,) + f2p.shape[1:], lambda bi, ii: (bi, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, n_j, k * k, c),
+                               lambda bi, ii: (bi, ii, 0, 0, 0),
+                               memory_space=pltpu.VMEM),
+        compiler_params=_CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=interpret,
+    )(coords, f2p)
+    # (b, i, j, dx·k+dy, c) → the sample_window (B, du, dv, H, W, C) layout
+    out = out.reshape(b, n_i, n_j, k, k, c)
+    return out.transpose(0, 3, 4, 1, 2, 5)
+
+
+def _sw_bwd_tpu(f2, coords, dout, radius, interpret=False):
+    b, n_i, n_j = coords.shape[:3]
+    c = f2.shape[-1]
+    k = 2 * radius + 1
+    lo, _hi_y, _hi_x = _wcp_pads(radius)
+    dims = (f2.shape[1], f2.shape[2])
+    (f2p,) = _wcp_pad_f2((f2,), radius)
+
+    # (B, du, dv, H, W, C) → the kernel's (b, i, j, dx·k+dy, c) row layout
+    doutr = dout.astype(jnp.float32).transpose(0, 3, 4, 1, 2, 5)
+    doutr = doutr.reshape(b, n_i, n_j, k * k, c)
+
+    df2 = pl.pallas_call(
+        functools.partial(_sw_bwd_kernel, radius=radius, dims=dims),
+        out_shape=jax.ShapeDtypeStruct(f2p.shape, jnp.float32),
+        grid=(b, n_i),
+        in_specs=[
+            pl.BlockSpec((1, 1, n_j, 2), lambda bi, ii: (bi, ii, 0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, n_j, k * k, c),
+                         lambda bi, ii: (bi, ii, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1,) + f2p.shape[1:],
+                               lambda bi, ii: (bi, 0, 0, 0),
+                               memory_space=pltpu.VMEM),
+        compiler_params=_CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=interpret,
+    )(coords, doutr)
+
+    h2, w2 = dims
+    return df2[:, lo:lo + h2, lo:lo + w2, :]
+
+
+def _sw_fwd_interpret(f2, coords, radius):
+    """Interpreter-mode forward (kernel correctness tests off-TPU)."""
+    return _sw_fwd_tpu(f2, coords, radius, interpret=True)
+
+
+def _sw_bwd_interpret(f2, coords, dout, radius):
+    """Interpreter-mode backward (kernel correctness tests off-TPU)."""
+    return _sw_bwd_tpu(f2, coords, dout, radius, interpret=True)
+
+
+def _sw_reference(f2, coords, radius):
+    """XLA fallback with identical semantics (used off-TPU and as the
+    numerical reference in tests)."""
+    from .sample import sample_window
+
+    return sample_window(f2, coords, radius)
+
+
+def _sw_fits_vmem(f2, coords, radius):
+    """Static shape check, mirroring ``_wcp_fits_vmem``: one (b, i)-row of
+    output plus the padded f2 map must sit in VMEM, and the x-selection
+    matrix covers the alignment shift only for radius ≤ 7."""
+    if radius > 7:
+        return False
+    lo, hi_y, hi_x = _wcp_pads(radius)
+    k = 2 * radius + 1
+    n_j, c = coords.shape[2], f2.shape[-1]
+    itemsize = 2 if f2.dtype == jnp.bfloat16 else 4
+    total = n_j * k * k * max(c, 128) * 4              # out row (lane-padded)
+    total += (f2.shape[1] + lo + hi_y) * (f2.shape[2] + lo + hi_x) \
+        * c * itemsize
+    return total <= 64 * 1024 * 1024
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _sw(f2, coords, radius):
+    if jax.default_backend() == "tpu" and _sw_fits_vmem(f2, coords, radius):
+        return _sw_fwd_tpu(f2, coords, radius)
+    return _sw_reference(f2, coords, radius)
+
+
+def _sw_vjp_fwd(f2, coords, radius):
+    return _sw(f2, coords, radius), (f2, coords)
+
+
+def _sw_vjp_bwd(radius, res, dout):
+    f2, coords = res
+    if jax.default_backend() == "tpu" and _sw_fits_vmem(f2, coords, radius):
+        df2 = _sw_bwd_tpu(f2, coords, dout, radius)
+    else:
+        def f(f2_):
+            return _sw_reference(f2_, jax.lax.stop_gradient(coords), radius)
+
+        out, vjp = jax.vjp(f, f2)
+        (df2,) = vjp(dout.astype(out.dtype))
+    # coords are stop_gradient'ed by every caller (the RAFT iteration
+    # detaches them); returning zeros keeps the vjp total
+    return df2.astype(f2.dtype), jnp.zeros_like(coords)
+
+
+_sw.defvjp(_sw_vjp_fwd, _sw_vjp_bwd)
+
+
+def sample_window_fused(f2, coords, radius=4):
+    """Fused (2r+1)² displaced-window sampler, (B, du, dv, H, W, C).
+
+    Drop-in for ``ops.sample.sample_window`` — same zero-padding
+    semantics, same (du varies dx) window layout — with the patch gather
+    and both separable lerps fused in VMEM on TPU (XLA reference path
+    elsewhere / for oversized shapes). Output dtype follows ``f2``; the
+    kernel computes in f32 and rounds once on write. Coordinates are
+    treated as non-differentiable (zero gradient): callers inside the
+    recurrent estimators detach the lookup centers.
+    """
+    return _sw(f2, coords, radius).astype(f2.dtype)
+
+
 def convex_combine_8x(mask_logits, win, temperature=4.0):
     """Fused softmax-over-neighbors + convex combine.
 

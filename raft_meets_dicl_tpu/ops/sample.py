@@ -58,6 +58,59 @@ def sample_bilinear(img, x, y):
     return out
 
 
+def sample_window(f2, coords, radius):
+    """Sample f2 at the (2r+1)² displaced positions around each coordinate.
+
+    f2: (B, H2, W2, C) features; coords: (B, H, W, 2) pixel positions *into
+    f2's grid* — the two resolutions may differ (multi-level lookups pass
+    coarser feature maps with rescaled coordinates). Returns
+    (B, du, dv, H, W, C) with zero padding outside — du varies dx.
+
+    All (2r+1)² displacements are integer offsets from one center, so they
+    share the center's bilinear fractions: instead of 4 corner gathers per
+    displacement (4K² rows per position through ``sample_bilinear``), one
+    (K+1)² integer patch is gathered per position and the displaced values
+    come from two static-shift lerps over the patch — 3.2x fewer gather
+    rows, the dominant cost of the DICL models' training step. Zero padding
+    falls out of masking OOB patch entries (every sampled value is a convex
+    combination of patch entries, exactly the grid_sample corner terms).
+
+    This is the XLA form (and the reference/fallback for the fused Pallas
+    kernel in ``ops.pallas.sample_window_fused``, which keeps the patch and
+    both lerps in VMEM instead of gathering through HBM).
+    """
+    b, h, w = coords.shape[:3]
+    h2, w2, c = f2.shape[-3:]
+    k = 2 * radius + 1
+    t = k + 1
+
+    # patch base = top-left corner of the displacement window
+    cx = coords[..., 0].reshape(b, -1) - radius      # (B, P)
+    cy = coords[..., 1].reshape(b, -1) - radius
+    x0f = jnp.floor(cx)
+    y0f = jnp.floor(cy)
+    fx = (cx - x0f)[:, None, None, :, None]          # (B, 1, 1, P, 1)
+    fy = (cy - y0f)[:, None, None, :, None]
+
+    # tap axes ordered (tx, ty) so the lerped output is (dx, dy)-major,
+    # matching window_delta's du-varies-dx channel layout
+    tx = jnp.arange(t, dtype=jnp.int32)[None, :, None, None]
+    ty = jnp.arange(t, dtype=jnp.int32)[None, None, :, None]
+    ix = x0f.astype(jnp.int32)[:, None, None, :] + tx   # (B, T, T, P)
+    iy = y0f.astype(jnp.int32)[:, None, None, :] + ty
+    inb = (ix >= 0) & (ix <= w2 - 1) & (iy >= 0) & (iy <= h2 - 1)
+    idx = (jnp.clip(iy, 0, h2 - 1) * w2 + jnp.clip(ix, 0, w2 - 1))
+
+    flat = f2.reshape(b, h2 * w2, c)
+    patch = jnp.take_along_axis(flat, idx.reshape(b, -1)[..., None], axis=1)
+    patch = patch.reshape(b, t, t, h * w, c) * inb[..., None]
+
+    # separable lerp over the shared fractions (static shifts only)
+    ylerp = (1.0 - fy) * patch[:, :, 0:k] + fy * patch[:, :, 1:t]
+    win = (1.0 - fx) * ylerp[:, 0:k] + fx * ylerp[:, 1:t]
+    return win.reshape(b, k, k, h, w, c)
+
+
 def grid_sample(img, grid):
     """``F.grid_sample(img, grid, align_corners=True)`` equivalent, NHWC.
 
