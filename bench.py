@@ -195,6 +195,156 @@ def _bench_input():
     return result
 
 
+def _bench_eval():
+    """Shape-bucketed evaluation benchmark (``BENCH_EVAL=1``): a synthetic
+    mixed-resolution eval set (three distinct raw shapes, KITTI-style) run
+    through (a) the batch-1 unbucketed baseline — one jit compile per
+    distinct padded shape — and (b) the bucketed pipeline (ShapeBuckets +
+    shape-grouping loader + partial-batch padding + precompile warmup).
+    Reports samples/s end-to-end (compiles included: that is what a
+    validation sweep costs), steady-state samples/s, compile counts, and
+    the pad-overhead ratio per preset. One cumulative JSON line per
+    measurement; consumers read the last."""
+    import jax
+
+    from raft_meets_dicl_tpu import evaluation, telemetry
+    from raft_meets_dicl_tpu.data.collection import (
+        Metadata, SampleArgs, SampleId,
+    )
+    from raft_meets_dicl_tpu.models import input as minput
+    import raft_meets_dicl_tpu.models as models
+
+    # KITTI's per-image resolutions: many *slightly different* raw shapes
+    # (375x1242, 370x1224, 374x1238, ...) — the baseline compiles one
+    # program per distinct padded shape, bucketing quantizes them all
+    # onto two canonical sizes
+    cpu = jax.default_backend() == "cpu"
+    if cpu:
+        shapes = [(64, 96), (64, 88), (64, 80), (56, 88), (56, 80),
+                  (56, 72), (48, 72), (48, 64)]
+        bucket_sizes = [(64, 96), (56, 88)]
+        per_shape = int(os.environ.get("BENCH_EVAL_SAMPLES", "6"))
+        batch = int(os.environ.get("BENCH_EVAL_BATCH", "4"))
+        iters = 2
+        model_params = {"corr-levels": 2, "corr-radius": 2,
+                        "corr-channels": 32, "context-channels": 16,
+                        "recurrent-channels": 16}
+    else:
+        shapes = [(376, 1248), (376, 1232), (368, 1232), (368, 1224),
+                  (360, 1224), (352, 1216)]
+        bucket_sizes = [(376, 1248), (368, 1232)]
+        per_shape = int(os.environ.get("BENCH_EVAL_SAMPLES", "8"))
+        batch = int(os.environ.get("BENCH_EVAL_BATCH", "8"))
+        iters = 12
+        model_params = {}
+
+    spec = models.load({
+        "name": "bench-eval", "id": "bench-eval",
+        "model": {"type": "raft/baseline", "parameters": model_params,
+                  "arguments": {"iterations": iters}},
+        "loss": {"type": "raft/sequence"},
+        "input": {"padding": {"type": "modulo", "mode": "zeros",
+                              "size": [8, 8]}},
+    })
+    model = spec.model
+
+    class Synth:
+        """Mixed-shape raw samples, round-robin over the shape list."""
+
+        def __init__(self, shapes, per_shape):
+            self.items = [s for s in shapes for _ in range(per_shape)]
+
+        def __getitem__(self, index):
+            h, w = self.items[index]
+            rng = np.random.RandomState(index)
+            img1 = rng.rand(1, h, w, 3).astype(np.float32)
+            img2 = rng.rand(1, h, w, 3).astype(np.float32)
+            flow = rng.randn(1, h, w, 2).astype(np.float32)
+            valid = np.ones((1, h, w), bool)
+            meta = [Metadata(True, "synth-mixed",
+                             SampleId(f"s{index}", SampleArgs(), SampleArgs()),
+                             ((0, h), (0, w)))]
+            return img1, img2, flow, valid, meta
+
+        def __len__(self):
+            return len(self.items)
+
+    source = Synth(shapes, per_shape)
+    init = source[0]
+    variables = model.init(jax.random.PRNGKey(0), init[0], init[1])
+
+    buckets = minput.ShapeBuckets(bucket_sizes)
+
+    def sweep(buckets, batch_size, pad_to=None, precompile=False, label=""):
+        tele = telemetry.get()
+        tail0 = len(getattr(tele, "events", ()))
+        loader = spec.input.apply(source, buckets=buckets).jax().loader(
+            batch_size=batch_size, shuffle=False,
+            group_by_shape=buckets is not None, num_workers=2)
+        stats = evaluation.EvalRunStats(name=label)
+        fn = evaluation.make_eval_fn(model, None)
+        t0 = time.perf_counter()
+        if precompile:
+            evaluation.warmup_eval_fn(fn, variables, buckets.sizes,
+                                      pad_to or batch_size, stats=stats)
+        epe_sum = n = 0.0
+        for s in evaluation.evaluate(model, variables, loader, eval_fn=fn,
+                                     show_progress=False, pad_to=pad_to,
+                                     stats=stats):
+            err = np.linalg.norm(s.final - s.target, axis=-1)
+            epe_sum += float(err[np.asarray(s.valid, bool)].mean())
+            n += 1
+        wall = time.perf_counter() - t0
+        # steady state: the sweep minus compile/warmup cost — what a
+        # second epoch over the same buckets would cost
+        tail = getattr(tele, "events", [])[tail0:]
+        compile_s = sum(e["seconds"] for e in tail
+                        if e["kind"] == "compile"
+                        and e.get("label") == "eval_step")
+        warm = stats.phases.get("warmup", 0.0)
+        steady = max(wall - max(warm, compile_s), 1e-9)
+        return {
+            "samples": int(n),
+            "samples_per_sec": round(n / wall, 3),
+            "samples_per_sec_steady": round(n / steady, 3),
+            "compiled_shapes": stats.compiles,
+            "compile_s": round(compile_s, 3),
+            "batches": stats.batches,
+            "pad_waste_ratio": round(stats.pad_waste_ratio(), 4),
+            "mean_epe": round(epe_sum / max(n, 1), 5),
+            "wall_s": round(wall, 3),
+        }
+
+    result = {
+        "metric": "eval-throughput-mixed-shapes",
+        "backend": jax.default_backend(),
+        "shapes": [f"{h}x{w}" for h, w in shapes],
+        "samples": len(source), "batch": batch,
+        "buckets": [f"{h}x{w}" for h, w in buckets.sizes],
+    }
+
+    # (a) baseline: batch 1, no bucketing — one compile per distinct shape
+    evaluation._EVAL_FN_CACHE.clear()
+    result["baseline_b1"] = sweep(None, 1, label="baseline-b1")
+    print(json.dumps(result), flush=True)
+
+    # (b) bucketed: grouped full batches, remainder padding, warm buckets
+    evaluation._EVAL_FN_CACHE.clear()
+    result["bucketed"] = sweep(buckets, batch, pad_to=batch,
+                               precompile=True, label="bucketed")
+    result["speedup_end_to_end"] = round(
+        result["bucketed"]["samples_per_sec"]
+        / max(result["baseline_b1"]["samples_per_sec"], 1e-9), 2)
+    result["speedup_steady"] = round(
+        result["bucketed"]["samples_per_sec_steady"]
+        / max(result["baseline_b1"]["samples_per_sec_steady"], 1e-9), 2)
+    result["epe_rel_diff"] = round(
+        abs(result["bucketed"]["mean_epe"] - result["baseline_b1"]["mean_epe"])
+        / max(abs(result["baseline_b1"]["mean_epe"]), 1e-9), 6)
+    print(json.dumps(result), flush=True)
+    return result
+
+
 def _bench_dicl():
     """Matching-phase breakdown (``BENCH_DICL=1``): window-sample ms (XLA
     gather vs fused Pallas sampler) and matching-net ms (per-level loop vs
@@ -312,6 +462,16 @@ def main():
         # input-pipeline-only mode: host-side decode/collate/wire-volume
         # numbers, no device required
         _bench_input()
+        return
+
+    if os.environ.get("BENCH_EVAL", "0") != "0":
+        # shape-bucketed evaluation: batch-1 per-shape baseline vs the
+        # bucketed recompile-free pipeline on a mixed-resolution set.
+        # No persistent compile cache here: cold compiles per distinct
+        # shape are exactly the cost being measured.
+        from raft_meets_dicl_tpu import telemetry
+        telemetry.activate(telemetry.create())
+        _bench_eval()
         return
 
     if os.environ.get("BENCH_DICL", "0") != "0":

@@ -84,10 +84,25 @@ def evaluate(args):
         wire = wire.bound(input.clip, input.range)
         logging.info(f"input wire format: {wire.describe()}")
 
+    # shape buckets: quantize mixed per-image resolutions onto a small
+    # canonical set and batch same-bucket samples — a KITTI-like sweep
+    # then compiles at most n_buckets programs instead of one per
+    # distinct padded shape, and batches stay full
+    import os
+
+    from ..models.input import ShapeBuckets
+
+    buckets_spec = (getattr(args, "buckets", None)
+                    or os.environ.get("RMD_EVAL_BUCKETS"))
+    buckets = ShapeBuckets.from_config(buckets_spec)
+    if buckets is not None:
+        logging.info(f"shape buckets: {buckets.describe()}")
+
     dataset = data.load(args.data)
-    loader = input.apply(dataset, normalize=wire is None).jax(
+    loader = input.apply(dataset, normalize=wire is None, buckets=buckets).jax(
         compute_metrics, wire=wire,
-    ).loader(batch_size=args.batch_size, shuffle=False, drop_last=False)
+    ).loader(batch_size=args.batch_size, shuffle=False, drop_last=False,
+             group_by_shape=buckets is not None)
 
     # variables from the checkpoint (structure target from a sample init;
     # init wants the normalized f32 contract, not the wire dtype)
@@ -123,11 +138,31 @@ def evaluate(args):
 
     logging.info(f"evaluating {len(loader.source)} samples")
 
+    # partial per-bucket batches (epoch-end remainders) are padded up to
+    # the full batch size so they reuse the bucket's compiled program
+    pad_to = args.batch_size if buckets is not None else None
+    stats = evaluation.EvalRunStats(name="evaluate")
+
+    eval_fn = evaluation.make_eval_fn(model, None, mesh=mesh, wire=wire)
+    if getattr(args, "precompile", False):
+        if buckets is None or not buckets.sizes:
+            raise ValueError(
+                "--precompile needs explicit bucket sizes (--buckets HxW,...)")
+        warm_batch = args.batch_size
+        if mesh is not None:
+            n = mesh.devices.size
+            warm_batch = -(-warm_batch // n) * n
+        logging.info(f"precompiling {len(buckets.sizes)} bucket shapes "
+                     f"at batch {warm_batch}")
+        evaluation.warmup_eval_fn(eval_fn, variables, buckets.sizes,
+                                  warm_batch, wire=wire, stats=stats)
+
     output = []
     ctx_m = metrics.MetricContext()
 
     for sample in evaluation.evaluate(model, variables, loader, mesh=mesh,
-                                      wire=wire):
+                                      wire=wire, eval_fn=eval_fn,
+                                      pad_to=pad_to, stats=stats):
         target = sample.target[None] if sample.target is not None else None
         valid = sample.valid[None] if sample.valid is not None else None
         est = sample.final[None]
@@ -157,6 +192,13 @@ def evaluate(args):
                 epe_args,
             )
 
+    logging.info(
+        f"evaluation sweep: {stats.samples} samples in {stats.batches} "
+        f"batches ({stats.samples_per_sec():.2f} samples/s, "
+        f"{stats.compiles} compiled shapes, "
+        f"pad waste {stats.pad_waste_ratio() * 100:.1f}%)")
+    stats.emit()
+
     if compute_metrics:
         logging.info("summary:")
         for collector in collectors.collectors:
@@ -171,8 +213,15 @@ def evaluate(args):
 
 
 def save_flow_image(dir, format, sample_id, img1, img2, target, valid, flow,
-                    out, size, visual_args, visual_dark_args, epe_args):
-    """One sample's output in the requested format (src/cmd/eval.py:274-303)."""
+                    out, size, visual_args, visual_dark_args, epe_args,
+                    batch_index=0):
+    """One sample's output in the requested format (src/cmd/eval.py:274-303).
+
+    ``batch_index`` selects the sample within ``out``'s batch dimension
+    for the intermediates dump — the evaluation generator yields
+    per-sample (batch-1) outputs, so the default 0 addresses that sample;
+    callers holding a full-batch result pass the real index.
+    """
     (h0, h1), (w0, w1) = size
     flow = flow[h0:h1, w0:w1]
     img1 = img1[h0:h1, w0:w1]
@@ -192,7 +241,8 @@ def save_flow_image(dir, format, sample_id, img1, img2, target, valid, flow,
         "visual:flow:gt": (save_flow_visual, [target], visual_args, "png"),
         "visual:i1": (save_image, [img1], {}, "png"),
         "visual:warp:backwards": (save_flow_visual_warp_backwards, [img2, flow], {}, "png"),
-        "visual:intermediate:flow": (save_intermediate_flow_visual, [out], visual_args, "png"),
+        "visual:intermediate:flow": (save_intermediate_flow_visual,
+                                     [out, batch_index], visual_args, "png"),
     }
 
     write, wargs, kwargs, ext = formats[format]
@@ -237,9 +287,15 @@ def save_flow_visual_warp_backwards(path, img2, flow):
     cv2.imwrite(str(path), _to_u8(visual.warp_backwards(img2, flow)[:, :, ::-1]))
 
 
-def save_intermediate_flow_visual(path, output, mrm=None, **kwargs):
+def save_intermediate_flow_visual(path, output, batch_index=0, mrm=None,
+                                  **kwargs):
     """Dump every intermediate flow, magnitude-normalized across levels by
-    width ratio (src/cmd/eval.py:338-383)."""
+    width ratio (src/cmd/eval.py:338-383).
+
+    ``batch_index`` picks the sample out of each node's leading batch
+    dimension, so a batched result dumps the requested sample's
+    intermediates instead of silently always writing sample 0.
+    """
     inter = output.intermediate_flow()
 
     flat = {}
@@ -252,7 +308,7 @@ def save_intermediate_flow_visual(path, output, mrm=None, **kwargs):
             for k, x in node.items():
                 unpack(x, f"{key}.{k}")
         else:
-            flat[key] = np.asarray(node)[0]  # batch size 1 guaranteed here
+            flat[key] = np.asarray(node)[batch_index]
 
     unpack(inter)
 

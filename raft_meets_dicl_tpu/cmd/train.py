@@ -39,16 +39,21 @@ class Environment:
         return cls(
             loader_args=cfg.get("loader", {}),
             wire=cfg.get("wire"),
+            eval=cfg.get("eval", {}),
             debug_nans=cfg.get("jax", {}).get("debug-nans", False),
             deterministic=cfg.get("jax", {}).get("deterministic", False),
         )
 
-    def __init__(self, loader_args={}, wire=None, debug_nans=False,
+    def __init__(self, loader_args={}, wire=None, eval={}, debug_nans=False,
                  deterministic=False):
         self.loader_args = dict(loader_args)
         # wire config: preset name ('f32'/'bf16'/'u8') or mapping with
         # images/flow/pack-valid keys (models.wire.WireFormat.from_config)
         self.wire = wire
+        # eval section: shape buckets for the validation/evaluation passes
+        # ({'buckets': 'HxW,...' | 'group' | {sizes, mode}}); the
+        # RMD_EVAL_BUCKETS env var overrides it
+        self.eval = dict(eval or {})
         self.debug_nans = debug_nans
         self.deterministic = deterministic
 
@@ -56,6 +61,7 @@ class Environment:
         return {
             "loader": self.loader_args,
             "wire": self.wire,
+            "eval": self.eval,
             "jax": {
                 "debug-nans": self.debug_nans,
                 "deterministic": self.deterministic,
@@ -320,11 +326,21 @@ def _train(args):
     if getattr(args, "loader_procs", None) is not None:
         loader_args["procs"] = args.loader_procs
 
+    # eval shape buckets: RMD_EVAL_BUCKETS > env config 'eval' section.
+    # The validation passes group same-bucket samples into full batches
+    # and compile at most one program per bucket (models.input.ShapeBuckets)
+    from ..models.input import ShapeBuckets
+
+    eval_buckets = ShapeBuckets.from_config(
+        os.environ.get("RMD_EVAL_BUCKETS") or env.eval.get("buckets"))
+    if eval_buckets is not None:
+        logging.info(f"validation shape buckets: {eval_buckets.describe()}")
+
     log = utils.logging.Logger()
     tctx = TrainingContext(
         log, path_out, strat, model_id, model_spec, model_adapter, loss, input,
         inspector, chkptm, mesh=mesh, step_limit=args.steps,
-        loader_args=loader_args, wire=wire,
+        loader_args=loader_args, wire=wire, eval_buckets=eval_buckets,
     )
 
     if args.checkpoint:

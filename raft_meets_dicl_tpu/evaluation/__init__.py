@@ -7,14 +7,15 @@ per batch, then unbatched per sample — same yield contract as the
 reference so eval commands/scripts iterate identically.
 """
 
-from dataclasses import dataclass
-from typing import Any, Optional
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .. import utils
+from .. import telemetry, utils
 
 
 @dataclass
@@ -73,6 +74,96 @@ def _cache_key(model, model_args, mesh=None, wire=None):
     return (id(model), args_key, mesh_key, wire_key)
 
 
+@dataclass
+class EvalRunStats:
+    """Aggregate accounting for one evaluation/validation sweep.
+
+    Tracks batches/samples per dispatch shape ("bucket"), the number of
+    freshly compiled programs (distinct shapes, cross-checked against the
+    telemetry sink's compile events when one is active), and the
+    pad-waste ratio — the fraction of dispatched pixels that are padding
+    (modulo/bucket pad plus batch fill). ``emit`` publishes the ``eval``
+    event into the active telemetry sink.
+    """
+
+    name: str = "eval"
+    samples: int = 0
+    batches: int = 0
+    pad_samples: int = 0
+    real_pixels: int = 0
+    total_pixels: int = 0
+    phases: Dict[str, float] = field(default_factory=dict)
+    buckets: Dict[str, Dict[str, int]] = field(default_factory=dict)
+    compiles: int = 0
+    _t0: float = field(default_factory=time.perf_counter)
+
+    def add_phase(self, phase, seconds):
+        self.phases[phase] = self.phases.get(phase, 0.0) + seconds
+
+    def add_batch(self, shape, samples, pad_samples, real_pixels, compiles=0):
+        h, w = shape
+        bucket = self._bucket(shape)
+        bucket["batches"] += 1
+        bucket["samples"] += samples
+        bucket["compiles"] += compiles
+        self.batches += 1
+        self.samples += samples
+        self.pad_samples += pad_samples
+        self.compiles += compiles
+        self.real_pixels += int(real_pixels)
+        self.total_pixels += (samples + pad_samples) * h * w
+
+    def add_warmup(self, shape, compiles):
+        """Precompile-warmup compiles count toward the bucket's (and the
+        run's) compile totals — they are the sweep's compile budget."""
+        self._bucket(shape)["compiles"] += compiles
+        self.compiles += compiles
+
+    def _bucket(self, shape):
+        key = f"{shape[0]}x{shape[1]}"
+        return self.buckets.setdefault(
+            key, {"batches": 0, "samples": 0, "compiles": 0})
+
+    def pad_waste_ratio(self):
+        if not self.total_pixels:
+            return 0.0
+        return 1.0 - self.real_pixels / self.total_pixels
+
+    def samples_per_sec(self):
+        dt = time.perf_counter() - self._t0
+        return self.samples / dt if dt > 0 else 0.0
+
+    def emit(self):
+        tele = telemetry.get()
+        if not tele.enabled or not self.batches:
+            return
+        tele.emit(
+            "eval", name=self.name, samples=self.samples,
+            batches=self.batches, seconds=round(time.perf_counter() - self._t0, 4),
+            samples_per_sec=round(self.samples_per_sec(), 3),
+            pad_samples=self.pad_samples, compiles=self.compiles,
+            pad_waste_ratio=round(self.pad_waste_ratio(), 4),
+            phases={k: round(v, 4) for k, v in self.phases.items()},
+            buckets=self.buckets,
+        )
+
+
+def _real_pixels(meta, shape, samples):
+    """Un-padded content pixels of a batch, from per-sample metadata
+    extents; metadata without extents (plain test stubs) counts the full
+    dispatch area, i.e. zero measured waste."""
+    h, w = shape
+    total = 0
+    for m in meta:
+        ext = getattr(m, "original_extents", None)
+        if ext is None:
+            total += h * w
+        else:
+            (y0, y1), (x0, x1) = ext
+            total += (y1 - y0) * (x1 - x0)
+    return total
+
+
 def make_eval_fn(model, model_args=None, mesh=None, wire=None):
     """Jitted ``(variables, img1, img2) -> (raw_output, final_flow)``.
 
@@ -108,6 +199,10 @@ def make_eval_fn(model, model_args=None, mesh=None, wire=None):
         data = NamedSharding(mesh, P("data"))
         step = jax.jit(step, in_shardings=(repl, data, data))
 
+    # compile events in events.jsonl attribute to 'eval_step'; the raw
+    # jit stays reachable via __wrapped__ (warmup_eval_fn uses it)
+    step = telemetry.instrument_jit("eval_step", step)
+
     if key is not None:
         while len(_EVAL_FN_CACHE) >= _EVAL_FN_CACHE_MAX:
             _EVAL_FN_CACHE.pop(next(iter(_EVAL_FN_CACHE)))
@@ -115,8 +210,39 @@ def make_eval_fn(model, model_args=None, mesh=None, wire=None):
     return step
 
 
+def warmup_eval_fn(eval_fn, variables, shapes, batch_size, wire=None,
+                   stats=None):
+    """Precompile an eval fn for every (H, W) bucket shape at
+    ``batch_size`` before the sweep touches real data.
+
+    Runs the jitted step on zero-filled dummies (one forward per shape) so
+    the jit cache — and, where enabled, the persistent compile cache — is
+    hot when the first real batch of each bucket arrives: a KITTI-like
+    sweep then compiles nothing mid-epoch. Dummy images are created in
+    the wire image dtype when a ``wire`` format is active.
+    """
+    if wire is not None:
+        dtype = wire.encode_image(np.zeros((1, 1, 1, 3), np.float32)).dtype
+    else:
+        dtype = np.float32
+
+    tele = telemetry.get()
+    for h, w in shapes:
+        t0 = time.perf_counter()
+        c0 = tele.counts().get("compile:eval_step", 0) if tele.enabled else 0
+        img = jnp.zeros((batch_size, int(h), int(w), 3), dtype)
+        out = eval_fn(variables, img, img)
+        jax.block_until_ready(out[1])
+        if stats is not None:
+            stats.add_phase("warmup", time.perf_counter() - t0)
+            stats.add_warmup(
+                (int(h), int(w)),
+                tele.counts().get("compile:eval_step", 0) - c0
+                if tele.enabled else 1)
+
+
 def evaluate(model, variables, data, model_args=None, show_progress=True,
-             eval_fn=None, mesh=None, wire=None):
+             eval_fn=None, mesh=None, wire=None, pad_to=None, stats=None):
     """Yield an ``EvalSample`` per dataset sample.
 
     ``data`` iterates batches ``(img1, img2, flow, valid, meta)`` in NHWC
@@ -126,12 +252,20 @@ def evaluate(model, variables, data, model_args=None, show_progress=True,
 
     With ``mesh`` the batch is sharded over the mesh's ``data`` axis;
     short batches are padded by repeating the last sample (padded outputs
-    are dropped — only real samples are yielded).
+    are dropped — only real samples are yielded). ``pad_to`` extends the
+    same treatment to *every* short batch: partial batches (e.g. a
+    bucket's epoch-end remainder under a shape-grouping loader) are
+    filled up to a fixed batch size so they reuse the full batch's
+    compiled program instead of compiling one per remainder size.
 
     With ``wire``, ``data`` must yield wire-format batches (an adapter
     built with the same WireFormat): images upload compact and decode on
     device; the yielded ``EvalSample.img1/img2`` are decoded back to the
     normalized f32 contract on the host.
+
+    ``stats`` (an :class:`EvalRunStats`) accumulates throughput, per-shape
+    batch/compile counts, and the pad-waste ratio; pass one to also emit
+    the run's ``eval`` telemetry event via ``stats.emit()``.
     """
     adapter = model.get_adapter()
     step = (eval_fn if eval_fn is not None
@@ -140,25 +274,58 @@ def evaluate(model, variables, data, model_args=None, show_progress=True,
     if show_progress:
         data = utils.logging.progress(data, unit="batch", leave=False)
 
+    tele = telemetry.get()
+    seen_shapes = set()
+
     def dispatch(item):
         img1, img2, flow, valid, meta = item
         batch = img1.shape[0]
 
-        j1, j2 = jnp.asarray(img1), jnp.asarray(img2)
+        target = batch
+        if pad_to is not None:
+            target = max(target, int(pad_to))
         if mesh is not None:
             n = mesh.devices.size
-            pad = (-batch) % n
-            if pad:
-                reps = [1] * (j1.ndim - 1)
-                j1 = jnp.concatenate([j1, jnp.tile(j1[-1:], [pad] + reps)])
-                j2 = jnp.concatenate([j2, jnp.tile(j2[-1:], [pad] + reps)])
+            target = -(-target // n) * n
+
+        t0 = time.perf_counter()
+        j1, j2 = jnp.asarray(img1), jnp.asarray(img2)
+        pad = target - batch
+        if pad:
+            reps = [1] * (j1.ndim - 1)
+            j1 = jnp.concatenate([j1, jnp.tile(j1[-1:], [pad] + reps)])
+            j2 = jnp.concatenate([j2, jnp.tile(j2[-1:], [pad] + reps)])
+
+        # compile accounting: the trace+compile happens synchronously
+        # inside the step call, so a fresh dispatch shape that takes a
+        # compile is visible in the sink's labeled event-count delta
+        # (fallback without telemetry: first-seen shapes, which
+        # overcounts only on warm jit/persistent caches)
+        key = (target,) + tuple(j1.shape[1:3])
+        new_shape = key not in seen_shapes
+        seen_shapes.add(key)
+        c0 = tele.counts().get("compile:eval_step", 0) if tele.enabled else 0
 
         out, final = step(variables, j1, j2)
+
+        compiles = 0
+        if new_shape:
+            compiles = (tele.counts().get("compile:eval_step", 0) - c0
+                        if tele.enabled else 1)
+
+        if stats is not None:
+            stats.add_phase("dispatch", time.perf_counter() - t0)
+            stats.add_batch(
+                img1.shape[1:3], batch, pad,
+                _real_pixels(meta, img1.shape[1:3], batch),
+                compiles=compiles,
+            )
         return item, out, final
 
     def drain(dispatched):
         (img1, img2, flow, valid, meta), out, final = dispatched
         batch = img1.shape[0]
+        t0 = time.perf_counter()
         if wire is not None:
             img1 = wire.decode_images_host(img1)
             img2 = wire.decode_images_host(img2)
@@ -170,6 +337,8 @@ def evaluate(model, variables, data, model_args=None, show_progress=True,
         out, final = jax.device_get((out, final))
 
         result = adapter.wrap_result(out, img1.shape[1:3])
+        if stats is not None:
+            stats.add_phase("drain", time.perf_counter() - t0)
 
         for b in range(batch):
             yield EvalSample(

@@ -333,9 +333,18 @@ class StrategyValidation(Validation):
         mtx = [m.build() for m in self.metrics]
         step = self._val_step(ctx, stage)
 
-        input = ctx.input.apply(val.source).jax()
+        # shape buckets (ctx.eval_buckets): quantize mixed per-sample
+        # resolutions onto canonical sizes and group same-bucket samples
+        # into full batches — the val step then compiles at most one
+        # program per bucket instead of one per distinct shape, and the
+        # extended valid mask keeps padded pixels out of every masked
+        # metric and loss
+        buckets = getattr(ctx, "eval_buckets", None)
+        input = ctx.input.apply(val.source, buckets=buckets).jax()
         data = input.loader(batch_size=val.batch_size, shuffle=False,
-                            drop_last=False, **ctx.loader_args)
+                            drop_last=False,
+                            group_by_shape=buckets is not None,
+                            **ctx.loader_args)
 
         desc = f"validation ({val.name}): stage {stage.index + 1}/{len(ctx.strategy.stages)}"
         if epoch is not None:
@@ -354,12 +363,47 @@ class StrategyValidation(Validation):
                                        jax.local_devices()[0])
         ctx_m = metrics.MetricContext(lr=ctx.last_lr, params=variables["params"])
 
+        from ..evaluation import EvalRunStats
+        stats = EvalRunStats(name=f"validation:{val.name}")
+        tele = telemetry.get()
+        seen_shapes = set()
+
         for i, (img1, img2, flow, valid, meta) in enumerate(samples):
+            batch = img1.shape[0]
+            pad = val.batch_size - batch if buckets is not None else 0
+            if pad > 0:
+                # epoch-end bucket remainder: fill up to the full batch
+                # size (reusing that bucket's compiled program) with
+                # repeats of the last sample whose valid mask is cleared,
+                # so the masked metrics and loss provably ignore them
+                img1 = np.concatenate([img1, np.repeat(img1[-1:], pad, 0)])
+                img2 = np.concatenate([img2, np.repeat(img2[-1:], pad, 0)])
+                flow = np.concatenate([flow, np.repeat(flow[-1:], pad, 0)])
+                valid = np.concatenate(
+                    [valid, np.zeros((pad,) + valid.shape[1:], bool)])
+
+            key = img1.shape[:3]
+            new_shape = key not in seen_shapes
+            seen_shapes.add(key)
+            c0 = (tele.counts().get("compile:val_step", 0)
+                  if tele.enabled else 0)
+
             est, loss = step(
                 variables, jnp.asarray(img1), jnp.asarray(img2),
                 jnp.asarray(flow), jnp.asarray(valid),
             )
             est, loss = jax.device_get((est, loss))
+
+            compiles = 0
+            if new_shape:
+                compiles = (tele.counts().get("compile:val_step", 0) - c0
+                            if tele.enabled else 1)
+            stats.add_batch(
+                img1.shape[1:3], batch, pad,
+                sum((m.original_extents[0][1] - m.original_extents[0][0])
+                    * (m.original_extents[1][1] - m.original_extents[1][0])
+                    for m in meta),
+                compiles=compiles)
 
             for m in mtx:
                 m.add(ctx_m, est, flow, valid, loss)
@@ -380,6 +424,7 @@ class StrategyValidation(Validation):
                 write_images(writer, self.images.prefix, j - j_min, img1, img2,
                              flow, est, valid, meta, ctx.step)
 
+        stats.emit()
         return mtx
 
 

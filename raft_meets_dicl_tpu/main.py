@@ -138,6 +138,16 @@ def main():
                        help="host->device batch wire format (compact image "
                             "dtype, on-device normalization) "
                             "[default: host-normalized f32]")
+    eval_.add_argument("--buckets", metavar="SPEC",
+                       help="shape buckets for mixed-resolution datasets: "
+                            "'group' (batch same-shape samples) or a "
+                            "comma-separated HxW list, e.g. "
+                            "'384x1280,448x1024' (quantize + batch; at "
+                            "most one jit compile per bucket). Also: "
+                            "RMD_EVAL_BUCKETS")
+    eval_.add_argument("--precompile", action="store_true",
+                       help="compile every declared bucket shape before "
+                            "the sweep (requires explicit --buckets sizes)")
 
     # subcommand: checkpoint
     chkpt = subp.add_parser("checkpoint", formatter_class=fmtcls,

@@ -58,37 +58,52 @@ class FlAll(Metric):
 
 
 class AverageAngularError(Metric):
+    """``masked: true`` restricts the mean to valid pixels — mandatory
+    under shape-bucketed (padded) evaluation; the default ``false`` keeps
+    the reference's unmasked semantics."""
+
     type = "aae"
 
     @classmethod
     def from_config(cls, cfg):
         cls._typecheck(cfg)
-        return cls(cfg.get("key", "AverageAngularError"))
+        return cls(cfg.get("key", "AverageAngularError"),
+                   bool(cfg.get("masked", False)))
 
-    def __init__(self, key: str = "AverageAngularError"):
+    def __init__(self, key: str = "AverageAngularError", masked: bool = False):
         self.key = key
+        self.masked = masked
 
     def get_config(self):
-        return {"type": self.type, "key": self.key}
+        return {"type": self.type, "key": self.key, "masked": self.masked}
 
     def compute(self, ctx, estimate, target, valid, loss):
-        return {self.key: float(F.average_angular_error(estimate, target))}
+        v = valid if self.masked else None
+        return {self.key: float(F.average_angular_error(estimate, target, v))}
 
 
 class FlowMagnitude(Metric):
+    """``masked: true`` restricts the mean to valid pixels (see
+    AverageAngularError)."""
+
     type = "flow-magnitude"
 
     @classmethod
     def from_config(cls, cfg):
         cls._typecheck(cfg)
-        return cls(cfg.get("ord", 2), cfg.get("key", "FlowMagnitude"))
+        return cls(cfg.get("ord", 2), cfg.get("key", "FlowMagnitude"),
+                   bool(cfg.get("masked", False)))
 
-    def __init__(self, ord: float = 2, key: str = "FlowMagnitude"):
+    def __init__(self, ord: float = 2, key: str = "FlowMagnitude",
+                 masked: bool = False):
         self.ord = ord
         self.key = key
+        self.masked = masked
 
     def get_config(self):
-        return {"type": self.type, "key": self.key, "ord": self.ord}
+        return {"type": self.type, "key": self.key, "ord": self.ord,
+                "masked": self.masked}
 
     def compute(self, ctx, estimate, target, valid, loss):
-        return {self.key: float(F.flow_magnitude(estimate, self.ord))}
+        v = valid if self.masked else None
+        return {self.key: float(F.flow_magnitude(estimate, self.ord, v))}

@@ -47,15 +47,19 @@ def fl_all(estimate, target, valid):
     return masked_mean(bad.astype(jnp.float32), valid)
 
 
-def average_angular_error(estimate, target):
+def average_angular_error(estimate, target, valid=None):
     """Mean angular error (degrees) between spatio-temporal vectors (u,v,1).
 
     Published definition (Barron et al.): the denominator is
     ``sqrt(|est|²+1)·sqrt(|tgt|²+1)``. The reference's AAE deviates twice
     (src/metrics/aae.py:32-41: NCHW channel indexing addresses the width
     axis, and the denominator drops the per-vector +1 terms under the
-    roots); this implementation follows the published formula. Like the
-    reference, no valid-mask filtering.
+    roots); this implementation follows the published formula.
+
+    ``valid`` restricts the mean to valid pixels — required under
+    shape-bucketed evaluation, where padded pixels must never contribute
+    (the reference applies no mask; pass ``valid=None`` for its exact
+    semantics).
     """
     u_est, v_est = estimate[..., 0], estimate[..., 1]
     u_tgt, v_tgt = target[..., 0], target[..., 1]
@@ -66,12 +70,19 @@ def average_angular_error(estimate, target):
     cos = (u_est * u_tgt + v_est * v_tgt + 1.0) / (n_est * n_tgt)
     cos = jnp.clip(cos, -1.0, 1.0)
 
-    return jnp.rad2deg(jnp.mean(jnp.arccos(cos)))
+    angles = jnp.arccos(cos)
+    if valid is None:
+        return jnp.rad2deg(jnp.mean(angles))
+    return jnp.rad2deg(masked_mean(angles, valid))
 
 
-def flow_magnitude(estimate, ord=2):
-    """Mean per-pixel flow-vector norm (src/metrics/flow.py:34-36)."""
-    return jnp.mean(jnp.linalg.norm(estimate, ord=ord, axis=-1))
+def flow_magnitude(estimate, ord=2, valid=None):
+    """Mean per-pixel flow-vector norm (src/metrics/flow.py:34-36);
+    ``valid`` restricts the mean to valid pixels (padded-batch safe)."""
+    mag = jnp.linalg.norm(estimate, ord=ord, axis=-1)
+    if valid is None:
+        return jnp.mean(mag)
+    return masked_mean(mag, valid)
 
 
 # -- pytree (gradient / parameter) statistics --------------------------------

@@ -22,6 +22,68 @@ from ..data.collection import Metadata, SampleArgs, SampleId
 FLOW_INF = 1e10
 
 
+# numpy pad modes shared by every padding flavor; the aliases map the
+# reference configs' torch-style names onto the equivalent numpy modes
+_NUMPY_PAD_MODES = (
+    "edge", "maximum", "mean", "median", "minimum", "reflect",
+    "symmetric", "wrap",
+)
+_PAD_MODE_ALIASES = {
+    "zeros": ("constant", {"constant_values": 0.0}),
+    "ones": ("constant", {"constant_values": 1.0}),
+    "torch.replicate": ("edge", {}),
+    "torch.reflect": ("reflect", {}),
+    "torch.circular": ("wrap", {}),
+}
+
+
+def _raw_pad_constant(value, clip, range):
+    """Map a *normalized-space* constant padding value into raw space.
+
+    Wire-format pipelines pad un-normalized values on the host; the
+    device-side clip+scale must map the padding back onto the configured
+    normalized constant, so the raw constant is the inverse normalization
+    (clamped into the clip interval, which the normalization saturates
+    anyway)."""
+    rmin, rmax = range
+    lo, hi = clip
+    c = (value - rmin) / (rmax - rmin)
+    return float(min(max(c, lo), hi))
+
+
+def _pad_arrays(img1, img2, flow, valid, meta, pad_h, pad_w, mode, args):
+    """Pad one NHWC sample batch by ``pad_h=(top, bottom)`` /
+    ``pad_w=(left, right)``: images with ``mode``, flow/valid always
+    zero-padded (padded pixels are invalid), metadata extents shifted."""
+    ph1, ph2 = pad_h
+    pw1, pw2 = pad_w
+
+    pad4 = ((0, 0), (ph1, ph2), (pw1, pw2), (0, 0))
+    pad3 = ((0, 0), (ph1, ph2), (pw1, pw2))
+
+    img1 = np.pad(img1, pad4, mode=mode, **args)
+    img2 = np.pad(img2, pad4, mode=mode, **args)
+
+    if flow is not None:
+        flow = np.pad(flow, pad4, mode="constant", constant_values=0)
+        valid = np.pad(valid, pad3, mode="constant", constant_values=False)
+
+    # new Metadata objects — sources may hand out the same instances on
+    # every access (e.g. wrap_single), so in-place shifts would accumulate
+    meta = [
+        replace(
+            m,
+            original_extents=(
+                (m.original_extents[0][0] + ph1, m.original_extents[0][1] + ph1),
+                (m.original_extents[1][0] + pw1, m.original_extents[1][1] + pw1),
+            ),
+        )
+        for m in meta
+    ]
+
+    return img1, img2, flow, valid, meta
+
+
 class Padding:
     type = None
 
@@ -62,17 +124,8 @@ class ModuloPadding(Padding):
 
     type = "modulo"
 
-    _NUMPY_MODES = (
-        "edge", "maximum", "mean", "median", "minimum", "reflect",
-        "symmetric", "wrap",
-    )
-    _ALIASES = {
-        "zeros": ("constant", {"constant_values": 0.0}),
-        "ones": ("constant", {"constant_values": 1.0}),
-        "torch.replicate": ("edge", {}),
-        "torch.reflect": ("reflect", {}),
-        "torch.circular": ("wrap", {}),
-    }
+    _NUMPY_MODES = _NUMPY_PAD_MODES
+    _ALIASES = _PAD_MODE_ALIASES
 
     @classmethod
     def from_config(cls, cfg):
@@ -124,13 +177,11 @@ class ModuloPadding(Padding):
         mode, args = self._ALIASES.get(self.mode, (self.mode, {}))
         if "constant_values" not in args:
             return self
-        rmin, rmax = range
-        lo, hi = clip
-        c = (args["constant_values"] - rmin) / (rmax - rmin)
         out = copy.copy(self)
         # raw-space constant, clipped into the clip interval so the
         # device-side clip+scale maps it back to the normalized constant
-        out._raw_constant = float(min(max(c, lo), hi))
+        out._raw_constant = _raw_pad_constant(
+            args["constant_values"], clip, range)
         return out
 
     def apply(self, img1, img2, flow, valid, meta):
@@ -147,33 +198,11 @@ class ModuloPadding(Padding):
             # array — measured ~10 ms/sample of pure memcpy in the loader
             return img1, img2, flow, valid, meta
 
-        ph1, ph2 = self._split(new_h - h, "top", self.align_vt)
-        pw1, pw2 = self._split(new_w - w, "left", self.align_hz)
+        pad_h = self._split(new_h - h, "top", self.align_vt)
+        pad_w = self._split(new_w - w, "left", self.align_hz)
 
-        pad4 = ((0, 0), (ph1, ph2), (pw1, pw2), (0, 0))
-        pad3 = ((0, 0), (ph1, ph2), (pw1, pw2))
-
-        img1 = np.pad(img1, pad4, mode=mode, **args)
-        img2 = np.pad(img2, pad4, mode=mode, **args)
-
-        if flow is not None:
-            flow = np.pad(flow, pad4, mode="constant", constant_values=0)
-            valid = np.pad(valid, pad3, mode="constant", constant_values=False)
-
-        # new Metadata objects — sources may hand out the same instances on
-        # every access (e.g. wrap_single), so in-place shifts would accumulate
-        meta = [
-            replace(
-                m,
-                original_extents=(
-                    (m.original_extents[0][0] + ph1, m.original_extents[0][1] + ph1),
-                    (m.original_extents[1][0] + pw1, m.original_extents[1][1] + pw1),
-                ),
-            )
-            for m in meta
-        ]
-
-        return img1, img2, flow, valid, meta
+        return _pad_arrays(img1, img2, flow, valid, meta, pad_h, pad_w,
+                           mode, args)
 
 
 _PADDINGS = {ModuloPadding.type: ModuloPadding}
@@ -183,6 +212,135 @@ def _build_padding(cfg):
     if cfg is None:
         return None
     return _PADDINGS[cfg["type"]].from_config(cfg)
+
+
+class ShapeBuckets:
+    """Canonical evaluation shapes: quantize mixed per-sample resolutions
+    up to a small fixed set so a whole benchmark sweep compiles at most
+    ``len(sizes)`` programs instead of one per distinct padded shape.
+
+    Each sample is padded (bottom/right, so ``meta.original_extents``
+    stays put) from its modulo-padded size up to the smallest configured
+    bucket that fits; the ``valid`` mask is extended with ``False`` over
+    the padded pixels, so masked metrics (EPE, Fl-all, the masked losses)
+    provably never see them. An empty ``sizes`` list is the pure
+    *grouping* policy: no quantization pad, the loader still groups
+    same-shape samples into full batches (``Loader(group_by_shape=True)``)
+    so mixed-resolution sets stop degrading to batch 1.
+
+    Assignment is deterministic: buckets are ordered by (area, height,
+    width) and the first one that fits both dimensions wins; samples
+    larger than every bucket keep their own shape (they batch among
+    themselves and compile their own program, like before).
+    """
+
+    def __init__(self, sizes=(), mode="zeros"):
+        if mode not in _NUMPY_PAD_MODES and mode not in _PAD_MODE_ALIASES:
+            raise ValueError(f"invalid bucket padding mode: {mode}")
+
+        parsed = []
+        for hw in sizes:
+            h, w = (int(x) for x in hw)
+            if h <= 0 or w <= 0:
+                raise ValueError(f"invalid bucket size {hw!r}")
+            parsed.append((h, w))
+
+        self.sizes = sorted(set(parsed), key=lambda s: (s[0] * s[1], s))
+        self.mode = mode
+
+    @classmethod
+    def from_config(cls, cfg):
+        """``None`` | spec string (see :meth:`parse`) | mapping with
+        ``sizes`` (list of [H, W]) and optional ``mode``."""
+        if cfg is None:
+            return None
+        if isinstance(cfg, str):
+            return cls.parse(cfg)
+        if isinstance(cfg, (list, tuple)):
+            return cls(cfg)
+        return cls(cfg.get("sizes", ()), cfg.get("mode", "zeros"))
+
+    @classmethod
+    def parse(cls, spec):
+        """CLI/env spec: ``'group'`` (shape grouping only) or a
+        comma-separated ``HxW`` list, e.g. ``'384x1280,448x1024'``."""
+        spec = spec.strip()
+        if not spec:
+            return None
+        if spec in ("group", "shape"):
+            return cls(())
+        sizes = []
+        for part in spec.split(","):
+            try:
+                h, w = part.strip().lower().split("x")
+                sizes.append((int(h), int(w)))
+            except ValueError:
+                raise ValueError(
+                    f"invalid bucket spec '{part.strip()}' in '{spec}': "
+                    "expected 'group' or a comma-separated HxW list "
+                    "like '384x1280,448x1024'") from None
+        return cls(sizes)
+
+    def get_config(self):
+        return {"sizes": [list(s) for s in self.sizes], "mode": self.mode}
+
+    def describe(self):
+        if not self.sizes:
+            return "group-by-shape (no canonical sizes)"
+        return ", ".join(f"{h}x{w}" for h, w in self.sizes)
+
+    def assign(self, h, w):
+        """Smallest-area bucket fitting an (h, w) sample, or None when no
+        bucket fits (the sample keeps its own shape)."""
+        for bh, bw in self.sizes:
+            if bh >= h and bw >= w:
+                return bh, bw
+        return None
+
+    def check_compatible(self, padding):
+        """Every bucket must satisfy the model's modulo constraint, else
+        the quantized shapes would be rejected by the network's pyramid —
+        fail at config time with the offending bucket named."""
+        if padding is None or not isinstance(padding, ModuloPadding):
+            return
+        mw, mh = padding.size  # config order: (w multiple, h multiple)
+        for bh, bw in self.sizes:
+            if bh % mh or bw % mw:
+                raise ValueError(
+                    f"bucket {bh}x{bw} is not a multiple of the input "
+                    f"padding size {mh}x{mw} (h x w): the model would "
+                    "reject the quantized shape")
+
+    def raw_variant(self, clip, range):
+        """Variant for un-normalized (wire-format) pipelines: constant
+        padding values translate into raw space (see ModuloPadding)."""
+        mode, args = _PAD_MODE_ALIASES.get(self.mode, (self.mode, {}))
+        if "constant_values" not in args:
+            return self
+        out = ShapeBuckets(self.sizes, self.mode)
+        out._raw_constant = _raw_pad_constant(
+            args["constant_values"], clip, range)
+        return out
+
+    def pad(self, img1, img2, flow, valid, meta):
+        """Pad one sample batch up to its bucket (no-op when no bucket
+        fits or the sample already sits on one)."""
+        _, h, w, _ = img1.shape
+        bucket = self.assign(h, w)
+        if bucket is None or bucket == (h, w):
+            return img1, img2, flow, valid, meta
+
+        mode, args = _PAD_MODE_ALIASES.get(self.mode, (self.mode, {}))
+        raw = getattr(self, "_raw_constant", None)
+        if raw is not None and "constant_values" in args:
+            args = dict(args, constant_values=raw)
+
+        bh, bw = bucket
+        return _pad_arrays(img1, img2, flow, valid, meta,
+                           (0, bh - h), (0, bw - w), mode, args)
+
+    def __call__(self, img1, img2, flow, valid, meta):
+        return self.pad(img1, img2, flow, valid, meta)
 
 
 class InputSpec:
@@ -214,11 +372,13 @@ class InputSpec:
             "padding": self.padding.get_config() if self.padding is not None else None,
         }
 
-    def apply(self, source, normalize=True):
+    def apply(self, source, normalize=True, buckets=None):
         """Wrap ``source``; ``normalize=False`` defers the clip/range
-        scaling to the device (wire-format pipelines)."""
+        scaling to the device (wire-format pipelines). ``buckets`` (a
+        ShapeBuckets) quantizes each sample's padded size up to a
+        canonical bucket for recompile-free mixed-resolution batching."""
         return Input(source, self.clip, self.range, self.padding,
-                     normalize=normalize)
+                     normalize=normalize, buckets=buckets)
 
     def wrap_single(self, img1, img2, flow=None, valid=None, seq=0, dsid="custom"):
         """Wrap one unbatched image pair as a one-sample input source."""
@@ -255,7 +415,7 @@ class Input:
     """
 
     def __init__(self, source, clip=(0.0, 1.0), range=(-1.0, 1.0),
-                 padding=None, normalize=True):
+                 padding=None, normalize=True, buckets=None):
         self.source = source
         self.clip = clip
         self.range = range
@@ -263,6 +423,11 @@ class Input:
         self.padding = padding
         if padding is not None and not normalize:
             self.padding = padding.raw_variant(clip, range)
+        if buckets is not None:
+            buckets.check_compatible(padding)
+            if not normalize:
+                buckets = buckets.raw_variant(clip, range)
+        self.buckets = buckets
 
     def __getitem__(self, index):
         img1, img2, flow, valid, meta = self.source[index]
@@ -276,6 +441,9 @@ class Input:
 
         if self.padding is not None:
             img1, img2, flow, valid, meta = self.padding(img1, img2, flow, valid, meta)
+
+        if self.buckets is not None:
+            img1, img2, flow, valid, meta = self.buckets(img1, img2, flow, valid, meta)
 
         return img1, img2, flow, valid, meta
 
@@ -373,11 +541,11 @@ class JaxAdapter:
         return len(self.source)
 
     def loader(self, batch_size=1, shuffle=False, num_workers=4, drop_last=False,
-               seed=None, shard=None, procs=None):
+               seed=None, shard=None, procs=None, group_by_shape=False):
         # no **kwargs catch-all: unknown loader arguments (typos in env
         # configs) must fail loudly instead of being silently dropped
         return Loader(self, batch_size, shuffle, num_workers, drop_last, seed,
-                      shard, procs)
+                      shard, procs, group_by_shape)
 
 
 def collate(samples, shuffle=False, rng=None):
@@ -387,6 +555,22 @@ def collate(samples, shuffle=False, rng=None):
     batch is the concatenation, optionally shuffled within the batch so
     paired samples don't always sit next to each other.
     """
+    base = samples[0][0].shape[1:]
+    for s in samples[1:]:
+        if s[0].shape[1:] != base:
+            def describe(smp, shape):
+                meta = smp[4]
+                ds = meta[0].dataset_id if meta and hasattr(
+                    meta[0], "dataset_id") else "<unknown dataset>"
+                return f"{shape[0]}x{shape[1]} (dataset '{ds}')"
+            raise ValueError(
+                "cannot batch samples of mixed shapes: "
+                f"{describe(samples[0], base)} vs "
+                f"{describe(s, s[0].shape[1:])} — use shape buckets "
+                "(--buckets / RMD_EVAL_BUCKETS / loader "
+                "group_by_shape=True) or batch size 1 for "
+                "mixed-resolution datasets")
+
     img1 = np.concatenate([s[0] for s in samples], axis=0)
     img2 = np.concatenate([s[1] for s in samples], axis=0)
 
@@ -429,16 +613,27 @@ class Loader:
     multi-host training. All shards see the same number of batches
     (processes must step in lockstep), so ``batch_size`` here is the
     per-process size.
+
+    ``group_by_shape`` reorders the epoch into full same-shape batches:
+    samples are fetched in epoch order but buffered per (H, W) shape key
+    and a batch is emitted whenever one shape's buffer fills (partial
+    buffers flush at epoch end, first-seen shape first). Within a batch
+    the epoch order — and with it the per-sample ``meta`` order — is
+    preserved. Combined with ShapeBuckets quantization this turns a
+    mixed-resolution evaluation epoch into at most ``n_buckets`` distinct
+    batch shapes instead of one tiny ragged batch per resolution.
     """
 
     def __init__(self, source, batch_size=1, shuffle=False, num_workers=4,
-                 drop_last=False, seed=None, shard=None, procs=None):
+                 drop_last=False, seed=None, shard=None, procs=None,
+                 group_by_shape=False):
         self.source = source
         self.batch_size = batch_size
         self.shuffle = shuffle
         self.num_workers = num_workers
         self.drop_last = drop_last
         self.shard = shard
+        self.group_by_shape = bool(group_by_shape)
         if procs is None:
             procs = int(os.environ.get("RMD_LOADER_PROCS", "0"))
         self.procs = max(0, int(procs))
@@ -461,13 +656,17 @@ class Loader:
             return n // self.batch_size
         return -(-n // self.batch_size)
 
-    def _batches(self):
+    def _order(self):
         order = self.rng.permutation(len(self.source)) if self.shuffle \
             else np.arange(len(self.source))
 
         if self.shard is not None:
             index, count = self.shard
             order = order[index::count][: self._shard_len()]
+        return order
+
+    def _batches(self):
+        order = self._order()
 
         for start in range(0, len(order), self.batch_size):
             chunk = order[start : start + self.batch_size]
@@ -476,6 +675,10 @@ class Loader:
             yield chunk
 
     def __iter__(self):
+        if self.group_by_shape:
+            yield from self._iter_grouped()
+            return
+
         if self.procs > 0:
             yield from self._iter_procs()
             return
@@ -503,6 +706,91 @@ class Loader:
                 samples = [f.result() for f in futures]
                 submit_next()
                 yield collate(samples, self.shuffle, self.rng)
+
+    def _iter_samples(self):
+        """Single samples in epoch order, decode pipelined a window ahead
+        (threads, decode processes, or synchronous per ``procs`` /
+        ``num_workers`` — same transports as the batch path)."""
+        order = self._order()
+
+        if self.procs > 0:
+            from . import mpdecode
+
+            pool = mpdecode.DecodePool(self.source, self.procs)
+            try:
+                it = iter(order)
+                pending = []
+
+                def submit_next():
+                    i = next(it, None)
+                    if i is not None:
+                        pending.append(pool.submit(int(i)))
+
+                for _ in range(max(2 * self.procs, 4)):
+                    submit_next()
+                while pending:
+                    sample, shm = pool.result(pending.pop(0))
+                    # copy out of shared memory immediately: grouped
+                    # samples can sit in a bucket buffer for a while, and
+                    # segments must not pile up until the batch flushes
+                    img1, img2, flow, valid, meta = sample
+                    sample = (np.copy(img1), np.copy(img2),
+                              None if flow is None else np.copy(flow),
+                              None if valid is None else np.copy(valid),
+                              meta)
+                    shm.close()
+                    shm.unlink()
+                    submit_next()
+                    yield sample
+            finally:
+                pool.shutdown()
+            return
+
+        if self.num_workers <= 0:
+            for i in order:
+                yield self.source[i]
+            return
+
+        with concurrent.futures.ThreadPoolExecutor(self.num_workers) as pool:
+            it = iter(order)
+            pending = []
+
+            def submit_next():
+                i = next(it, None)
+                if i is not None:
+                    pending.append(pool.submit(self.source.__getitem__, int(i)))
+
+            for _ in range(max(2 * self.num_workers, 2 * self.batch_size)):
+                submit_next()
+            while pending:
+                sample = pending.pop(0).result()
+                submit_next()
+                yield sample
+
+    def _iter_grouped(self):
+        """Shape-grouping mode: buffer fetched samples per (H, W) key and
+        emit a full batch whenever one shape's buffer fills; partial
+        buffers flush at epoch end in first-seen order (dropped under
+        ``drop_last``). Epoch order is preserved within each group, so
+        per-sample ``meta`` order within a batch is stable."""
+        groups = {}
+        seen = []
+
+        for sample in self._iter_samples():
+            key = sample[0].shape[1:3]
+            if key not in groups:
+                groups[key] = []
+                seen.append(key)
+            buf = groups[key]
+            buf.append(sample)
+            if sum(s[0].shape[0] for s in buf) >= self.batch_size:
+                groups[key] = []
+                yield collate(buf, self.shuffle, self.rng)
+
+        if not self.drop_last:
+            for key in seen:
+                if groups[key]:
+                    yield collate(groups[key], self.shuffle, self.rng)
 
     def _iter_procs(self):
         """Decode-process path: same two-batch pipelining as the thread

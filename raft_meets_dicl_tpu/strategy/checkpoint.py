@@ -11,6 +11,8 @@ Retention (name-templated paths with metric values, best-by-expression and
 keep-latest trimming) matches the reference manager exactly.
 """
 
+import concurrent.futures
+import os
 import re
 from collections import defaultdict
 from dataclasses import dataclass
@@ -24,6 +26,29 @@ from flax import serialization
 from .. import utils
 
 _MAGIC = b"RMDT1\n"
+
+# single background writer shared by all managers: serializing two
+# checkpoints concurrently would just thrash memory, and one ordered lane
+# keeps writes in creation order. Threads are non-daemon, so a clean
+# interpreter exit waits for in-flight writes instead of truncating them.
+_WRITER: Optional[concurrent.futures.ThreadPoolExecutor] = None
+
+
+def _writer():
+    global _WRITER
+    if _WRITER is None:
+        _WRITER = concurrent.futures.ThreadPoolExecutor(
+            1, thread_name_prefix="chkpt-write")
+    return _WRITER
+
+
+def _write_atomic(path, payload):
+    """Write via tmp file + rename so a reader (or a crash mid-write)
+    never sees a truncated checkpoint."""
+    path = Path(path)
+    tmp = path.with_name(f".{path.name}.tmp{os.getpid()}")
+    tmp.write_bytes(payload)
+    os.replace(tmp, path)
 
 
 @dataclass
@@ -179,9 +204,31 @@ class Checkpoint:
             path,
         )
 
-    def save(self, path):
-        payload = serialization.msgpack_serialize(_to_host(self.to_dict()))
-        Path(path).write_bytes(_MAGIC + payload)
+    def save(self, path, background=False):
+        """Serialize to ``path`` (atomically, via tmp file + rename).
+
+        ``background=True`` splits the work at the host boundary: the
+        device→host snapshot (``_to_host`` — the part that must see a
+        consistent state) runs synchronously, then the msgpack encode and
+        file write happen on the shared background writer thread, and a
+        ``concurrent.futures.Future`` (resolving to the seconds the
+        background half took) is returned — training no longer stalls for
+        the full serialize+write. Synchronous saves return None.
+        """
+        state = _to_host(self.to_dict())
+
+        def write():
+            import time
+
+            t0 = time.perf_counter()
+            payload = serialization.msgpack_serialize(state)
+            _write_atomic(path, _MAGIC + payload)
+            return time.perf_counter() - t0
+
+        if not background:
+            write()
+            return None
+        return _writer().submit(write)
 
     def apply(self, variables=None, opt_state=None, scaler=None,
               lr_sched_inst=(), lr_sched_epoch=()):
@@ -217,8 +264,18 @@ class CheckpointEntry:
     idx_step: int
     metrics: Optional[Dict[str, float]]
     path: Optional[Path]
+    # in-flight background write (strategy.checkpoint.Checkpoint.save with
+    # background=True); load() and deletion join it first
+    pending: Optional[Any] = None
+
+    def wait(self):
+        """Block until any in-flight background write has finished."""
+        if self.pending is not None:
+            self.pending.result()
+            self.pending = None
 
     def load(self, **kwargs) -> Checkpoint:
+        self.wait()
         return Checkpoint.load(self.path, **kwargs)
 
     def __hash__(self):
@@ -318,6 +375,10 @@ class CheckpointManager:
 
         if delete:
             for entry in remove - keep:
+                # a checkpoint whose background write is still in flight
+                # must finish before the unlink (else the write recreates
+                # the file after deletion)
+                entry.wait()
                 entry.path.unlink(missing_ok=True)
 
     def create(self, log, ctx, stage, epoch, step, metrics):
@@ -349,8 +410,11 @@ class CheckpointManager:
         from .. import telemetry
 
         # timed from state assembly: the device->host fetch of the full
-        # param/opt tree, not just the file write, is the step stall a
-        # checkpoint causes
+        # param/opt tree is the unavoidable step stall a checkpoint causes.
+        # The msgpack encode + file write then run on a background thread
+        # (RMD_ASYNC_CHECKPOINT=0 restores the fully synchronous save), so
+        # training resumes after the snapshot instead of the full
+        # serialize+write.
         t0 = time.perf_counter()
         chkpt = Checkpoint(
             model=self.model_id,
@@ -369,11 +433,36 @@ class CheckpointManager:
             },
         )
 
-        chkpt.save(entry.path)
-        telemetry.get().emit(
-            "checkpoint", path=str(entry.path), step=step,
-            seconds=round(time.perf_counter() - t0, 4),
-        )
+        background = os.environ.get("RMD_ASYNC_CHECKPOINT", "1") != "0"
+        tele = telemetry.get()
+
+        def emit(blocking, bg):
+            # `blocking` is the stall create() imposed on the train loop
+            # (state snapshot [+ full write when synchronous]), `bg` the
+            # serialize+write seconds that ran off the loop
+            tele.emit(
+                "checkpoint", path=str(entry.path), step=step,
+                seconds=round(blocking + bg, 4),
+                blocking_ms=round(blocking * 1e3, 1),
+                background_ms=round(bg * 1e3, 1),
+            )
+
+        if background:
+            write = chkpt.save(entry.path, background=True)
+            blocking = time.perf_counter() - t0
+
+            def finish():
+                bg = write.result()
+                emit(blocking, bg)
+                return bg
+
+            # same single-lane writer: runs after the write, so waiting
+            # on entry.pending implies both the file and the telemetry
+            # event exist
+            entry.pending = _writer().submit(finish)
+        else:
+            chkpt.save(entry.path, background=False)
+            emit(time.perf_counter() - t0, 0.0)
 
         self.checkpoints.append(entry)
         self.trim(n_best=self.keep_best, n_latest=self.keep_latest)

@@ -117,7 +117,8 @@ def _make_put(base_put, wire, tele):
 class TrainingContext:
     def __init__(self, log, path, strategy, model_id, model, model_adapter,
                  loss, input, inspector, checkpoints, mesh=None,
-                 step_limit=None, loader_args={}, wire=None):
+                 step_limit=None, loader_args={}, wire=None,
+                 eval_buckets=None):
         self.root_log = log
         self.log = log
         self.path = Path(path)
@@ -136,6 +137,10 @@ class TrainingContext:
         # legacy host-normalized f32 batches.
         self.wire = (wire.bound(input.clip, input.range)
                      if wire is not None else None)
+        # shape buckets for the validation passes (models.input.ShapeBuckets):
+        # mixed-resolution validation sets batch per bucket and compile at
+        # most one val-step program per bucket
+        self.eval_buckets = eval_buckets
 
         self.validate = True
 

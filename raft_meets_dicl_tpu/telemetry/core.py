@@ -49,6 +49,9 @@ SCHEMA = {
     "memory": {"host_rss_gib", "live_arrays"},
     "nonfinite": {"step"},
     "checkpoint": {"path", "step", "seconds"},
+    # one evaluation/validation sweep: samples/s, per-bucket batch and
+    # compile counts, pad-waste ratio (see evaluation.EvalRunStats)
+    "eval": {"name", "samples", "batches", "seconds"},
 }
 
 _FLUSH_EVERY = 128
@@ -153,6 +156,12 @@ class Telemetry:
         ev = {"v": SCHEMA_VERSION, "t": time.time(), "kind": kind, **fields}
         with self._lock:
             self._counts[kind] = self._counts.get(kind, 0) + 1
+            if kind == "compile":
+                # label-qualified count: lets consumers (eval compile
+                # accounting) separate the instrumented program they care
+                # about from incidental eager-op compiles
+                k = f"compile:{fields.get('label')}"
+                self._counts[k] = self._counts.get(k, 0) + 1
             if self.path is None:
                 self.events.append(ev)
                 return ev

@@ -173,6 +173,31 @@ def find_anomalies(events, warmup_steps=DEFAULT_WARMUP_STEPS,
     return flags
 
 
+def eval_stats(events):
+    """Per-sweep evaluation summaries from ``eval`` events: name,
+    samples/s, compile count, pad-waste ratio, and the per-bucket batch
+    breakdown (shape-bucketed evaluation, PR 4)."""
+    out = []
+    for e in events:
+        if e["kind"] != "eval":
+            continue
+        secs = e["seconds"]
+        out.append({
+            "name": e["name"],
+            "samples": e["samples"],
+            "batches": e["batches"],
+            "seconds": secs,
+            "samples_per_sec": e.get(
+                "samples_per_sec",
+                e["samples"] / secs if secs else 0.0),
+            "compiles": e.get("compiles", 0),
+            "pad_waste_ratio": e.get("pad_waste_ratio", 0.0),
+            "buckets": e.get("buckets", {}),
+            "phases": e.get("phases", {}),
+        })
+    return out
+
+
 def _fmt_ms(seconds):
     try:
         return f"{seconds * 1e3:9.2f}"
@@ -238,6 +263,23 @@ def render(events, errors=(), warmup_steps=DEFAULT_WARMUP_STEPS,
             f"{dev['steps_covered']} sampled steps "
             f"({dev['samples']} syncs, mean drain "
             f"{dev['mean_drain'] * 1e3:.2f} ms)")
+
+    evals = eval_stats(events)
+    if evals:
+        lines.append("")
+        lines.append("== evaluation ==")
+        lines.append(f"{'sweep':<16} {'samples':>8} {'smp/s':>8} "
+                     f"{'compiles':>9} {'pad-waste':>10}")
+        for ev in evals:
+            lines.append(
+                f"{ev['name']:<16} {ev['samples']:>8d} "
+                f"{ev['samples_per_sec']:>8.2f} {ev['compiles']:>9d} "
+                f"{ev['pad_waste_ratio'] * 100:>9.1f}%")
+            for key, b in sorted(ev["buckets"].items()):
+                lines.append(
+                    f"  bucket {key:<12} {b['samples']:>6d} samples in "
+                    f"{b['batches']} batches, {b.get('compiles', 0)} "
+                    "compiles")
 
     if compiles or caches:
         lines.append("")

@@ -128,6 +128,7 @@ def test_summary_inspector_end_to_end(tmp_path):
     assert len(mgr.checkpoints) == 1
     entry = mgr.checkpoints[0]
     assert "EndPointError/mean" in entry.metrics
+    entry.wait()  # the save's serialize+write runs on a background thread
     assert entry.path.exists()
     assert "-epe" in entry.path.name
 

@@ -248,6 +248,9 @@ def test_smoke_train_emits_schema_valid_events(tmp_path, monkeypatch):
         assert ctx.step == 2
         mgr.create(ctx.log, ctx, ctx.current_stage, epoch=0, step=ctx.step,
                    metrics={"loss": 1.0})
+        # checkpoint writes (and their telemetry event) run on the
+        # background writer; join before closing the sink
+        mgr.checkpoints[-1].wait()
     finally:
         telemetry.deactivate()
 
@@ -274,6 +277,14 @@ def test_smoke_train_emits_schema_valid_events(tmp_path, monkeypatch):
 
     compiles = [e for e in events if e["kind"] == "compile"]
     assert any(e["label"] == "train_step" for e in compiles)
+
+    # async checkpoint save: the event splits the loop stall (snapshot)
+    # from the background serialize+write milliseconds
+    chk = [e for e in events if e["kind"] == "checkpoint"][-1]
+    assert chk["blocking_ms"] >= 0.0
+    assert chk["background_ms"] > 0.0
+    assert chk["seconds"] == pytest.approx(
+        (chk["blocking_ms"] + chk["background_ms"]) / 1e3, abs=1e-3)
 
     text = report.render(events)
     assert "step phase breakdown" in text
