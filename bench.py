@@ -24,13 +24,16 @@ import numpy as np
 BASELINE_PAIRS_PER_SEC_PER_CHIP = 400.0 / 32.0
 
 
-def _measure(model_cfg, loss_cfg, batch, height, width, model_args, steps):
+def _measure(model_cfg, loss_cfg, batch, height, width, model_args, steps,
+             nonfinite=None):
     """One synthetic training-step throughput measurement; all device
     state is local, so buffers free when it returns.
 
     Returns (pairs_per_sec, peak_bytes, telemetry_summary) — the summary
     carries compile/cache counts from the active telemetry sink plus
-    dispatch-time stats, so BENCH_*.json records more than one number."""
+    dispatch-time stats, so BENCH_*.json records more than one number.
+    ``nonfinite='skip'`` builds the step with the non-finite skip guard
+    (BENCH_FAULT overhead measurement)."""
     import optax
 
     import raft_meets_dicl_tpu.models as models
@@ -58,7 +61,8 @@ def _measure(model_cfg, loss_cfg, batch, height, width, model_args, steps):
 
     tx = optax.chain(optax.clip_by_global_norm(1.0), optax.adamw(4e-4))
     state = parallel.TrainState.create(variables, tx)
-    step = parallel.make_train_step(model, loss, tx, model_args=model_args)
+    step = parallel.make_train_step(model, loss, tx, model_args=model_args,
+                                    nonfinite=nonfinite)
 
     tele = telemetry.get()
     tail0 = len(getattr(tele, "events", ()))
@@ -457,7 +461,65 @@ def _bench_dicl():
     return result
 
 
+def _bench_fault():
+    """Fault-tolerance overhead (``BENCH_FAULT=1``): per-step cost of the
+    non-finite recovery machinery. Measures the same synthetic training
+    step (a) unguarded (policy ``raise``: one isfinite reduce over the
+    final flow, as always) and (b) with the skip guard compiled in
+    (policies ``skip``/``rollback``: isfinite over the update tree plus
+    the conditional state select). Target: within noise. One JSON line;
+    consumers read the last."""
+    cpu = jax.default_backend() == "cpu"
+    if cpu:
+        batch, height, width, iters, steps = 2, 64, 96, 4, 3
+    else:
+        batch = int(os.environ.get("BENCH_BATCH", "6"))
+        height = int(os.environ.get("BENCH_HEIGHT", "400"))
+        width = int(os.environ.get("BENCH_WIDTH", "720"))
+        iters = int(os.environ.get("BENCH_ITERS", "12"))
+        steps = int(os.environ.get("BENCH_STEPS", "10"))
+
+    model_cfg = {"type": "raft/baseline",
+                 "parameters": {"mixed-precision": not cpu}}
+    loss_cfg = {"type": "raft/sequence"}
+
+    result = {
+        "metric": "fault-overhead",
+        "backend": jax.default_backend(),
+        "batch": batch, "height": height, "width": width,
+        "iterations": iters, "steps": steps,
+    }
+    plain, _, psum = _measure(model_cfg, loss_cfg, batch, height, width,
+                              {"iterations": iters}, steps)
+    result["plain_pairs_per_sec"] = round(plain, 3)
+    if psum is not None:
+        result["plain_step_ms"] = psum["step_ms_mean"]
+    print(json.dumps(result), flush=True)
+
+    guarded, _, gsum = _measure(model_cfg, loss_cfg, batch, height, width,
+                                {"iterations": iters}, steps,
+                                nonfinite="skip")
+    result["guarded_pairs_per_sec"] = round(guarded, 3)
+    if gsum is not None:
+        result["guarded_step_ms"] = gsum["step_ms_mean"]
+    result["overhead_pct"] = round((plain / guarded - 1.0) * 100, 2) \
+        if guarded else None
+    print(json.dumps(result), flush=True)
+    return result
+
+
 def main():
+    if os.environ.get("BENCH_FAULT", "0") != "0":
+        # non-finite guard overhead: unguarded vs skip-guarded train step
+        from raft_meets_dicl_tpu.utils.compcache import (
+            enable_persistent_cache,
+        )
+        enable_persistent_cache()
+        from raft_meets_dicl_tpu import telemetry
+        telemetry.activate(telemetry.create())
+        _bench_fault()
+        return
+
     if os.environ.get("BENCH_INPUT", "0") != "0":
         # input-pipeline-only mode: host-side decode/collate/wire-volume
         # numbers, no device required

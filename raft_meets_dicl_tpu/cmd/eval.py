@@ -157,6 +157,23 @@ def evaluate(args):
         evaluation.warmup_eval_fn(eval_fn, variables, buckets.sizes,
                                   warm_batch, wire=wire, stats=stats)
 
+    # incremental per-sample JSONL: one line per evaluated sample, flushed
+    # as it is computed — a crash mid-sweep keeps everything up to the
+    # crash instead of losing the whole report
+    inc_path = None
+    if not getattr(args, "no_incremental", False):
+        if getattr(args, "incremental", None):
+            inc_path = Path(args.incremental)
+        elif path_out is not None and compute_metrics:
+            inc_path = path_out.parent / (path_out.stem + ".samples.jsonl")
+    inc_fd = None
+    if inc_path is not None and compute_metrics:
+        inc_path.parent.mkdir(parents=True, exist_ok=True)
+        inc_fd = open(inc_path, "w")
+        logging.info(f"appending per-sample metrics to '{inc_path}'")
+
+    import json
+
     output = []
     ctx_m = metrics.MetricContext()
 
@@ -174,8 +191,12 @@ def evaluate(args):
             ))
             sample_metrs = mtx(ctx_m, est, target, valid, sample_loss)
 
-            output.append({"id": str(sample.meta.sample_id), "metrics": sample_metrs})
+            record = {"id": str(sample.meta.sample_id), "metrics": sample_metrs}
+            output.append(record)
             collectors.collect(sample_metrs)
+            if inc_fd is not None:
+                inc_fd.write(json.dumps(record) + "\n")
+                inc_fd.flush()
 
             info = [f"{k}: {v:.04f}" for k, v in sample_metrs.items()]
             logging.info(f"sample: {sample.meta.sample_id}, {', '.join(info)}")
@@ -191,6 +212,9 @@ def evaluate(args):
                 sample.meta.original_extents, visual_args, visual_dark_args,
                 epe_args,
             )
+
+    if inc_fd is not None:
+        inc_fd.close()
 
     logging.info(
         f"evaluation sweep: {stats.samples} samples in {stats.batches} "

@@ -40,12 +40,13 @@ class Environment:
             loader_args=cfg.get("loader", {}),
             wire=cfg.get("wire"),
             eval=cfg.get("eval", {}),
+            nonfinite=cfg.get("nonfinite"),
             debug_nans=cfg.get("jax", {}).get("debug-nans", False),
             deterministic=cfg.get("jax", {}).get("deterministic", False),
         )
 
-    def __init__(self, loader_args={}, wire=None, eval={}, debug_nans=False,
-                 deterministic=False):
+    def __init__(self, loader_args={}, wire=None, eval={}, nonfinite=None,
+                 debug_nans=False, deterministic=False):
         self.loader_args = dict(loader_args)
         # wire config: preset name ('f32'/'bf16'/'u8') or mapping with
         # images/flow/pack-valid keys (models.wire.WireFormat.from_config)
@@ -54,6 +55,11 @@ class Environment:
         # ({'buckets': 'HxW,...' | 'group' | {sizes, mode}}); the
         # RMD_EVAL_BUCKETS env var overrides it
         self.eval = dict(eval or {})
+        # nonfinite section: non-finite step recovery policy — a policy
+        # name or {policy, max-consecutive, window, max-rollbacks}
+        # (strategy.training.NonFinitePolicy); --nonfinite and
+        # RMD_NONFINITE override it
+        self.nonfinite = nonfinite
         self.debug_nans = debug_nans
         self.deterministic = deterministic
 
@@ -62,6 +68,7 @@ class Environment:
             "loader": self.loader_args,
             "wire": self.wire,
             "eval": self.eval,
+            "nonfinite": self.nonfinite,
             "jax": {
                 "debug-nans": self.debug_nans,
                 "deterministic": self.deterministic,
@@ -336,11 +343,23 @@ def _train(args):
     if eval_buckets is not None:
         logging.info(f"validation shape buckets: {eval_buckets.describe()}")
 
+    # non-finite step recovery policy: CLI flag > RMD_NONFINITE > env
+    # config 'nonfinite' section. Default is the historical raise.
+    from ..strategy.training import NonFinitePolicy
+
+    nf_cfg = (getattr(args, "nonfinite", None)
+              or os.environ.get("RMD_NONFINITE")
+              or env.nonfinite)
+    nonfinite = NonFinitePolicy.from_config(nf_cfg)
+    if nonfinite.policy != "raise":
+        logging.info(f"non-finite step policy: {nonfinite.get_config()}")
+
     log = utils.logging.Logger()
     tctx = TrainingContext(
         log, path_out, strat, model_id, model_spec, model_adapter, loss, input,
         inspector, chkptm, mesh=mesh, step_limit=args.steps,
         loader_args=loader_args, wire=wire, eval_buckets=eval_buckets,
+        nonfinite=nonfinite,
     )
 
     if args.checkpoint:
@@ -349,9 +368,28 @@ def _train(args):
         tctx._ensure_variables(strat.stages[args.start_stage or 0])
         tctx.variables, _, _ = warm.apply(variables=tctx.variables)
 
-    if args.resume:
+    if args.resume == "auto":
+        # preemption-safe auto-resume: find the newest valid checkpoint
+        # (emergency saves included) under the output base directory —
+        # corrupt files are quarantined and the next-newest one wins.
+        # Stage/epoch/step reconstruct from the checkpoint's iteration.
+        found = strategy.find_auto_resume(Path(args.output), model=model_id,
+                                          log=log)
+        if found is None:
+            raise ValueError(
+                f"--resume auto: no valid checkpoint for model "
+                f"'{model_id}' found under '{args.output}'")
+        resume_path, chkpt = found
+        logging.info(
+            f"auto-resume: picking up from '{resume_path}' "
+            f"(stage {chkpt.iteration.stage}, epoch {chkpt.iteration.epoch}, "
+            f"step {chkpt.iteration.step})")
+        tele.emit("resume", path=str(resume_path), step=chkpt.iteration.step,
+                  stage=chkpt.iteration.stage, epoch=chkpt.iteration.epoch)
+    elif args.resume:
         logging.info(f"loading checkpoint '{args.resume}'")
         chkpt = strategy.Checkpoint.load(args.resume)
+        tele.emit("resume", path=str(args.resume), step=chkpt.iteration.step)
 
     if args.detect_anomaly:
         log.warn("anomaly detection enabled")
@@ -368,6 +406,10 @@ def _train(args):
     tele.emit("run_start", dir=str(path_out),
               commit=utils.vcs.get_git_head_hash(),
               comment=args.comment or "")
+
+    # preemption safety: SIGTERM/SIGINT finish the in-flight step, write
+    # an emergency checkpoint, and return cleanly (--resume auto resumes)
+    tctx.install_signal_handlers()
 
     try:
         tctx.run(args.start_stage, args.start_epoch, chkpt)

@@ -55,7 +55,18 @@ def main():
                        help="comma-separated device indices for the SPMD data mesh")
     train.add_argument("--checkpoint",
                        help="start with pre-trained model state from checkpoint")
-    train.add_argument("--resume", help="resume training from checkpoint (full state)")
+    train.add_argument("--resume",
+                       help="resume training from checkpoint (full state); "
+                            "'auto' discovers the newest valid checkpoint "
+                            "(emergency saves included) under the output "
+                            "directory, quarantining corrupt files")
+    train.add_argument("--nonfinite", choices=["raise", "skip", "rollback"],
+                       help="non-finite step recovery policy: raise (abort, "
+                            "default), skip (drop the poisoned optimizer "
+                            "update on device and continue), rollback "
+                            "(skip, then restore the last valid checkpoint "
+                            "when trips persist). Also: RMD_NONFINITE or "
+                            "the env config's 'nonfinite' section")
     train.add_argument("--start-stage", type=int,
                        help="start with specified stage and skip previous")
     train.add_argument("--start-epoch", type=int,
@@ -111,6 +122,13 @@ def main():
                        help="specification of metrics to use for evaluation")
     eval_.add_argument("-o", "--output",
                        help="write detailed output to this file (json or yaml)")
+    eval_.add_argument("--incremental", metavar="PATH",
+                       help="append per-sample metrics to this JSONL as the "
+                            "sweep runs, so a crash keeps partial results "
+                            "[default: <output>.samples.jsonl when -o is "
+                            "set]")
+    eval_.add_argument("--no-incremental", action="store_true",
+                       help="disable the incremental per-sample JSONL")
     eval_.add_argument("-f", "--flow",
                        help="compute and write flow images to specified directory")
     from .cmd.eval import FLOW_FORMATS

@@ -10,6 +10,7 @@ GIL) rather than a torch DataLoader with worker processes.
 import concurrent.futures
 import copy
 import os
+import threading
 from dataclasses import replace
 
 import numpy as np
@@ -541,11 +542,13 @@ class JaxAdapter:
         return len(self.source)
 
     def loader(self, batch_size=1, shuffle=False, num_workers=4, drop_last=False,
-               seed=None, shard=None, procs=None, group_by_shape=False):
+               seed=None, shard=None, procs=None, group_by_shape=False,
+               retries=None, bad_sample_budget=None):
         # no **kwargs catch-all: unknown loader arguments (typos in env
         # configs) must fail loudly instead of being silently dropped
         return Loader(self, batch_size, shuffle, num_workers, drop_last, seed,
-                      shard, procs, group_by_shape)
+                      shard, procs, group_by_shape, retries,
+                      bad_sample_budget)
 
 
 def collate(samples, shuffle=False, rng=None):
@@ -593,6 +596,11 @@ def collate(samples, shuffle=False, rng=None):
     return img1, img2, flow, valid, meta
 
 
+class _DecodeFailed(Exception):
+    """Wrapper distinguishing per-sample decode errors (retryable) from
+    pool-level failures (fatal) on the decode-process path."""
+
+
 class Loader:
     """Batching iterator over an adapter: threads or decode processes.
 
@@ -626,7 +634,7 @@ class Loader:
 
     def __init__(self, source, batch_size=1, shuffle=False, num_workers=4,
                  drop_last=False, seed=None, shard=None, procs=None,
-                 group_by_shape=False):
+                 group_by_shape=False, retries=None, bad_sample_budget=None):
         self.source = source
         self.batch_size = batch_size
         self.shuffle = shuffle
@@ -640,6 +648,106 @@ class Loader:
         if seed is None:
             seed = int(np.random.randint(0, 2**31 - 1))
         self.rng = np.random.default_rng(seed)
+
+        # self-healing fetch: a failing sample decode is retried
+        # ``retries`` times, then a neighboring sample is substituted in
+        # its place (batch shapes — and with them the compiled step
+        # programs — stay stable). Every substitution burns one unit of
+        # the bad-sample budget; exceeding it aborts the epoch: at that
+        # point the data (or its storage) is broken, not flaky.
+        if retries is None:
+            retries = int(os.environ.get("RMD_LOADER_RETRIES", "2"))
+        self.retries = max(0, int(retries))
+        if bad_sample_budget is None:
+            bad_sample_budget = int(
+                os.environ.get("RMD_BAD_SAMPLE_BUDGET", "16"))
+        self.bad_sample_budget = max(0, int(bad_sample_budget))
+        self._bad_samples = 0
+        self._bad_lock = threading.Lock()
+
+    def _note_bad_sample(self, index, error):
+        from .. import telemetry, utils
+
+        if isinstance(error, _DecodeFailed):
+            error = error.__cause__
+        if self.bad_sample_budget <= 0:
+            # budget 0 = healing off: the original error propagates as-is
+            raise error
+        with self._bad_lock:
+            self._bad_samples += 1
+            bad = self._bad_samples
+        utils.logging.Logger("data:loader").warn(
+            f"sample {index} failed to decode after {self.retries + 1} "
+            f"attempt(s) ({type(error).__name__}: {error}); substituting a "
+            f"neighbor ({bad}/{self.bad_sample_budget} bad-sample budget)")
+        telemetry.get().emit("bad_sample", index=int(index),
+                             error=f"{type(error).__name__}: {error}",
+                             bad_samples=bad)
+        if bad > self.bad_sample_budget:
+            raise RuntimeError(
+                f"bad-sample budget exceeded ({bad} > "
+                f"{self.bad_sample_budget}): the input data is "
+                "persistently failing to decode") from error
+
+    def _fetch(self, index, fetch=None, retry_on=Exception):
+        """``source[index]`` with bounded retry, then substitution.
+
+        ``fetch`` overrides the raw per-index fetch (the decode-process
+        path goes through the pool); only ``retry_on`` exceptions count
+        as per-sample decode failures — anything else (pool breakage,
+        timeouts) propagates immediately. Deterministic neighbor
+        substitution keeps batch shapes (and compiled programs) stable;
+        repeated samples are harmless to training, unlike a mid-run
+        crash.
+        """
+        index = int(index)
+        fetch = fetch if fetch is not None else self.source.__getitem__
+        last = None
+        for _ in range(self.retries + 1):
+            try:
+                return fetch(index)
+            except retry_on as e:  # injected/IO decode failures
+                last = e
+        self._note_bad_sample(index, last)
+
+        n = len(self.source)
+        for k in range(1, min(n, 8)):
+            sub = (index + k) % n
+            try:
+                return fetch(sub)
+            except retry_on as e:
+                self._note_bad_sample(sub, e)
+        raise RuntimeError(
+            f"sample {index} and every substitution candidate failed to "
+            "decode") from last
+
+    def _pool_result(self, pool, seq, index):
+        """Decode-pool result with the same retry/substitute discipline.
+
+        The first attempt consumes the already-pipelined result; retries
+        and substitutions go through a blocking submit+result round trip
+        (only the failing sample loses pipelining). Pool-level failures
+        (worker respawn exhaustion, wedged-pipeline timeouts) are not
+        per-sample problems and propagate unretried.
+        """
+        from .mpdecode import PoolBroken
+
+        state = {"first": True}
+
+        def once(i):
+            s = seq if state.pop("first", False) and i == index \
+                else pool.submit(i)
+            try:
+                return pool.result(s)
+            except (TimeoutError, PoolBroken):
+                raise
+            except Exception as e:  # noqa: BLE001 - worker decode error
+                raise _DecodeFailed(e) from e
+
+        try:
+            return self._fetch(index, fetch=once, retry_on=_DecodeFailed)
+        except _DecodeFailed as e:  # pragma: no cover - unwrapped below
+            raise e.__cause__
 
     def _shard_len(self):
         n = len(self.source)
@@ -685,7 +793,7 @@ class Loader:
 
         if self.num_workers <= 0:
             for chunk in self._batches():
-                samples = [self.source[i] for i in chunk]
+                samples = [self._fetch(i) for i in chunk]
                 yield collate(samples, self.shuffle, self.rng)
             return
 
@@ -697,7 +805,7 @@ class Loader:
             def submit_next():
                 chunk = next(batches, None)
                 if chunk is not None:
-                    pending.append([pool.submit(self.source.__getitem__, i) for i in chunk])
+                    pending.append([pool.submit(self._fetch, i) for i in chunk])
 
             submit_next()
             submit_next()
@@ -724,12 +832,12 @@ class Loader:
                 def submit_next():
                     i = next(it, None)
                     if i is not None:
-                        pending.append(pool.submit(int(i)))
+                        pending.append((pool.submit(int(i)), int(i)))
 
                 for _ in range(max(2 * self.procs, 4)):
                     submit_next()
                 while pending:
-                    sample, shm = pool.result(pending.pop(0))
+                    sample, shm = self._pool_result(pool, *pending.pop(0))
                     # copy out of shared memory immediately: grouped
                     # samples can sit in a bucket buffer for a while, and
                     # segments must not pile up until the batch flushes
@@ -748,7 +856,7 @@ class Loader:
 
         if self.num_workers <= 0:
             for i in order:
-                yield self.source[i]
+                yield self._fetch(i)
             return
 
         with concurrent.futures.ThreadPoolExecutor(self.num_workers) as pool:
@@ -758,7 +866,7 @@ class Loader:
             def submit_next():
                 i = next(it, None)
                 if i is not None:
-                    pending.append(pool.submit(self.source.__getitem__, int(i)))
+                    pending.append(pool.submit(self._fetch, int(i)))
 
             for _ in range(max(2 * self.num_workers, 2 * self.batch_size)):
                 submit_next()
@@ -806,15 +914,15 @@ class Loader:
             def submit_next():
                 chunk = next(batches, None)
                 if chunk is not None:
-                    pending.append([pool.submit(i) for i in chunk])
+                    pending.append([(pool.submit(i), int(i)) for i in chunk])
 
             submit_next()
             submit_next()
             while pending:
                 seqs = pending.pop(0)
                 samples, segments = [], []
-                for seq in seqs:
-                    sample, shm = pool.result(seq)
+                for seq, index in seqs:
+                    sample, shm = self._pool_result(pool, seq, index)
                     samples.append(sample)
                     segments.append(shm)
                 submit_next()

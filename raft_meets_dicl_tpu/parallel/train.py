@@ -42,12 +42,20 @@ def _with_data_axis(n, fn):
 
 
 class TrainState(struct.PyTreeNode):
-    """Everything the train step carries: params, BN stats, optimizer."""
+    """Everything the train step carries: params, BN stats, optimizer.
+
+    ``nonfinite_count`` is the cumulative number of optimizer updates the
+    skip-guard refused to apply (see ``make_train_step(nonfinite='skip')``)
+    — living on device, it rides along for free and lets the host read
+    "how many steps tripped since the last fetch" with the same amortized
+    fetch that resolves the finite flag, instead of a per-step sync.
+    """
 
     params: Any
     batch_stats: Any
     opt_state: Any
     step: jax.Array
+    nonfinite_count: jax.Array
 
     @classmethod
     def create(cls, variables, tx):
@@ -57,6 +65,7 @@ class TrainState(struct.PyTreeNode):
             batch_stats=variables.get("batch_stats", {}),
             opt_state=tx.init(params),
             step=jnp.zeros((), jnp.int32),
+            nonfinite_count=jnp.zeros((), jnp.int32),
         )
 
     def variables(self):
@@ -65,7 +74,7 @@ class TrainState(struct.PyTreeNode):
 
 def make_train_step(model, loss_fn, tx, mesh=None, loss_args=None,
                     model_args=None, donate=True, external_lr=False,
-                    with_grads=False, wire=None):
+                    with_grads=False, wire=None, nonfinite=None):
     """Build the jitted training step.
 
     Static per-stage configuration (``model_args``, ``loss_args``) is baked
@@ -90,9 +99,21 @@ def make_train_step(model, loss_fn, tx, mesh=None, loss_args=None,
     clip/range-normalized on device, f16 flow, optionally bit-packed
     valid masks. The host-side pipeline must then skip normalization
     (``InputSpec.apply(..., normalize=False)``).
+
+    ``nonfinite='skip'`` compiles the skip-step discipline of dynamic
+    loss scaling (Micikevicius et al. 2018) into the step: when the
+    final flow or the post-clip update tree contains a non-finite value,
+    the params/batch-stats/optimizer update is dropped on device (the
+    previous state carries forward bit-identically) and
+    ``state.nonfinite_count`` increments. ``aux['finite']`` then means
+    "this step's update applied"; detection needs no extra host sync.
+    The default (None) keeps the unguarded update: NaNs are absorbing
+    through the optimizer state, which is what the ``raise`` policy's
+    amortized trip detection relies on.
     """
     loss_args = dict(loss_args or {})
     model_args = dict(model_args or {})
+    guard = nonfinite == "skip"
 
     def step(state, lr, img1, img2, flow, valid):
         if wire is not None:
@@ -116,16 +137,41 @@ def make_train_step(model, loss_fn, tx, mesh=None, loss_args=None,
             updates = jax.tree.map(lambda u: -lr * u, updates)
         new_params = optax.apply_updates(state.params, updates)
 
+        finite = jnp.all(jnp.isfinite(final))
+        nf_count = state.nonfinite_count
+
+        if guard:
+            # the update tree is where every poison ends up (NaN grads ->
+            # NaN moments -> NaN updates; NaN lr -> NaN updates), so one
+            # reduce over it catches grad/optimizer/lr poison before the
+            # params do — checking it alongside the flow keeps batch_stats
+            # poison (via a NaN loss/forward) covered too
+            ok = finite
+            for leaf in jax.tree.leaves(updates):
+                ok &= jnp.all(jnp.isfinite(leaf))
+
+            def keep(new, old):
+                return jax.tree.map(
+                    lambda n, o: jnp.where(ok, n, o), new, old)
+
+            new_params = keep(new_params, state.params)
+            new_bs = keep(new_bs, state.batch_stats)
+            new_opt = keep(new_opt, state.opt_state)
+            finite = ok
+            nf_count = nf_count + jnp.where(ok, 0, 1).astype(jnp.int32)
+
         new_state = state.replace(
             params=new_params,
             batch_stats=new_bs,
             opt_state=new_opt,
             step=state.step + 1,
+            nonfinite_count=nf_count,
         )
         aux = {
             "loss": loss,
             "final": final,
-            "finite": jnp.all(jnp.isfinite(final)),
+            "finite": finite,
+            "nonfinite_count": nf_count,
         }
         if with_grads:
             aux["grads"] = grads
@@ -150,7 +196,8 @@ def make_train_step(model, loss_fn, tx, mesh=None, loss_args=None,
 
     repl = NamedSharding(mesh, P())
     data = NamedSharding(mesh, P("data"))
-    aux_shardings = {"loss": repl, "final": data, "finite": repl}
+    aux_shardings = {"loss": repl, "final": data, "finite": repl,
+                     "nonfinite_count": repl}
     if with_grads:
         aux_shardings["grads"] = repl
 

@@ -1,14 +1,17 @@
 """Training strategy layer: specs, runtime, checkpoints, inspector."""
 
 from . import checkpoint, config, inspector, spec, training
-from .checkpoint import Checkpoint, CheckpointManager
+from .checkpoint import (
+    Checkpoint, CheckpointCorrupt, CheckpointManager, find_auto_resume,
+)
 from .config import load, load_stage
 from .inspector import Inspector
 from .spec import Stage, Strategy
-from .training import TrainingContext
+from .training import NonFinitePolicy, TrainingContext
 
 __all__ = [
     "checkpoint", "config", "inspector", "spec", "training",
-    "Checkpoint", "CheckpointManager", "Inspector", "Stage", "Strategy",
-    "TrainingContext", "load", "load_stage",
+    "Checkpoint", "CheckpointCorrupt", "CheckpointManager", "Inspector",
+    "NonFinitePolicy", "Stage", "Strategy", "TrainingContext",
+    "find_auto_resume", "load", "load_stage",
 ]

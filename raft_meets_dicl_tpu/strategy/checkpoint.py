@@ -9,11 +9,21 @@ flax state-dict (restored against a freshly built optimizer's structure).
 
 Retention (name-templated paths with metric values, best-by-expression and
 keep-latest trimming) matches the reference manager exactly.
+
+Integrity: v2 files (``RMDT2``) carry a CRC32 of the payload right after
+the magic, verified on every load — a bit flip or truncation raises
+:class:`CheckpointCorrupt` instead of a msgpack error deep in restore.
+Corrupt files are quarantined (renamed ``*.corrupt``) by the recovery
+paths (``CheckpointManager.load_valid``, :func:`find_auto_resume`) which
+fall back to the next-newest valid entry. v1 files (``RMDT1``, no
+checksum) still load.
 """
 
 import concurrent.futures
 import os
 import re
+import struct
+import zlib
 from collections import defaultdict
 from dataclasses import dataclass
 from datetime import datetime
@@ -24,14 +34,50 @@ import numpy as np
 from flax import serialization
 
 from .. import utils
+from ..testing import faults
 
-_MAGIC = b"RMDT1\n"
+_MAGIC_V1 = b"RMDT1\n"   # legacy: no checksum
+_MAGIC = b"RMDT2\n"      # current: 4-byte LE CRC32 of payload after magic
+_CRC_LEN = 4
+
+
+class CheckpointCorrupt(ValueError):
+    """A checkpoint file failed integrity verification (bad magic, CRC
+    mismatch, truncation, or msgpack decode failure)."""
+
+
+def quarantine(path):
+    """Rename a corrupt checkpoint out of the discovery namespace.
+
+    ``foo.ckpt`` becomes ``foo.ckpt.corrupt`` (numbered if that exists)
+    so retention scans and auto-resume stop considering it while the
+    bytes stay on disk for a post-mortem. Emits a ``quarantine``
+    telemetry event; returns the new path (or None if the rename lost a
+    race with another process)."""
+    from .. import telemetry
+
+    path = Path(path)
+    dst = path.with_name(path.name + ".corrupt")
+    n = 1
+    while dst.exists():
+        dst = path.with_name(f"{path.name}.corrupt{n}")
+        n += 1
+    try:
+        os.replace(path, dst)
+    except OSError:
+        return None
+    telemetry.get().emit("quarantine", path=str(path), moved_to=str(dst))
+    return dst
 
 # single background writer shared by all managers: serializing two
 # checkpoints concurrently would just thrash memory, and one ordered lane
 # keeps writes in creation order. Threads are non-daemon, so a clean
 # interpreter exit waits for in-flight writes instead of truncating them.
 _WRITER: Optional[concurrent.futures.ThreadPoolExecutor] = None
+
+# process-wide checkpoint-save ordinal, consumed by the
+# ``corrupt_checkpoint@nth=K`` fault directive (testing.faults)
+_SAVES = 0
 
 
 def _writer():
@@ -171,10 +217,26 @@ class Checkpoint:
     @classmethod
     def load(cls, path, strip_prefix=None):
         raw = Path(path).read_bytes()
-        if not raw.startswith(_MAGIC):
-            raise ValueError(f"not a checkpoint file: {path}")
+        if raw.startswith(_MAGIC):
+            header_len = len(_MAGIC) + _CRC_LEN
+            if len(raw) < header_len:
+                raise CheckpointCorrupt(f"truncated checkpoint: {path}")
+            (crc,) = struct.unpack("<I", raw[len(_MAGIC):header_len])
+            payload = raw[header_len:]
+            if zlib.crc32(payload) != crc:
+                raise CheckpointCorrupt(
+                    f"checkpoint checksum mismatch (bit rot or truncated "
+                    f"write): {path}")
+        elif raw.startswith(_MAGIC_V1):
+            payload = raw[len(_MAGIC_V1):]  # legacy, no checksum
+        else:
+            raise CheckpointCorrupt(f"not a checkpoint file: {path}")
 
-        cfg = serialization.msgpack_restore(raw[len(_MAGIC):])
+        try:
+            cfg = serialization.msgpack_restore(payload)
+        except Exception as e:  # noqa: BLE001 - decoder errors vary by impl
+            raise CheckpointCorrupt(
+                f"checkpoint payload undecodable: {path} ({e})") from e
 
         if strip_prefix:
             # pytree-key analog of the reference's module.-prefix stripping
@@ -222,7 +284,13 @@ class Checkpoint:
 
             t0 = time.perf_counter()
             payload = serialization.msgpack_serialize(state)
-            _write_atomic(path, _MAGIC + payload)
+            crc = struct.pack("<I", zlib.crc32(payload))
+            _write_atomic(path, _MAGIC + crc + payload)
+            if faults.active():
+                global _SAVES
+                _SAVES += 1
+                if faults.fire("corrupt_checkpoint", nth=_SAVES) is not None:
+                    faults.corrupt_file(path)
             return time.perf_counter() - t0
 
         if not background:
@@ -267,12 +335,36 @@ class CheckpointEntry:
     # in-flight background write (strategy.checkpoint.Checkpoint.save with
     # background=True); load() and deletion join it first
     pending: Optional[Any] = None
+    # background write raised: the file is absent or unusable, retention
+    # and recovery must not treat this entry as a real checkpoint
+    failed: bool = False
 
     def wait(self):
-        """Block until any in-flight background write has finished."""
+        """Block until any in-flight background write has finished.
+
+        A write that failed on the background thread re-raises here (and
+        marks the entry ``failed``) — the error must surface at the next
+        synchronization point instead of dying with the writer thread."""
         if self.pending is not None:
-            self.pending.result()
-            self.pending = None
+            pending, self.pending = self.pending, None
+            try:
+                pending.result()
+            except BaseException as e:
+                self.failed = True
+                raise RuntimeError(
+                    f"background checkpoint write failed: '{self.path}' "
+                    f"({type(e).__name__}: {e})") from e
+
+    def write_failed(self):
+        """Non-blocking: True once a finished background write is known
+        to have raised (marks the entry failed, keeps the exception for
+        ``wait()`` to re-raise)."""
+        if self.failed:
+            return True
+        if self.pending is not None and self.pending.done():
+            if self.pending.exception() is not None:
+                self.failed = True
+        return self.failed
 
     def load(self, **kwargs) -> Checkpoint:
         self.wait()
@@ -337,7 +429,9 @@ class CheckpointManager:
         return entry.idx_stage, entry.idx_epoch, entry.idx_step
 
     def _filtered(self, stage, epoch):
-        chkpts = self.checkpoints
+        # entries whose background write is known to have failed have no
+        # usable file behind them — queries must never hand them out
+        chkpts = [c for c in self.checkpoints if not c.write_failed()]
         if stage is not None and epoch is not None:
             return [c for c in chkpts if c.idx_stage == stage and c.idx_epoch == epoch]
         if stage is not None:
@@ -352,6 +446,37 @@ class CheckpointManager:
     def get_latest(self, stage=None, epoch=None) -> Optional[CheckpointEntry]:
         return max(self._filtered(stage, epoch), key=self._sort_key_latest,
                    default=None)
+
+    def load_valid(self, sort="latest", stage=None, log=None):
+        """Load the best/latest checkpoint that actually verifies.
+
+        Entries are tried in ``sort`` order ("latest" or "best"); a
+        corrupt file is quarantined (renamed ``*.corrupt``), dropped
+        from the manager, and the next entry is tried — the recovery
+        discipline for rollback and stage-boundary restores. Returns
+        ``(entry, Checkpoint)`` or None when nothing valid remains.
+        """
+        key = (self._sort_key_best if sort == "best"
+               else self._sort_key_latest)
+        ordered = sorted(self._filtered(stage, None), key=key,
+                         reverse=sort != "best")
+        for entry in ordered:
+            try:
+                return entry, entry.load()
+            except CheckpointCorrupt as e:
+                if log is not None:
+                    log.error(f"quarantining corrupt checkpoint: {e}")
+                quarantine(entry.path)
+                self.checkpoints = [c for c in self.checkpoints
+                                    if c is not entry]
+            except (RuntimeError, OSError) as e:
+                # failed background write / missing file: drop, move on
+                if log is not None:
+                    log.error(f"skipping unusable checkpoint "
+                              f"'{entry.path}': {e}")
+                self.checkpoints = [c for c in self.checkpoints
+                                    if c is not entry]
+        return None
 
     def trim(self, n_best=1, n_latest=1, delete=True):
         if n_best is None and n_latest is None:
@@ -391,6 +516,16 @@ class CheckpointManager:
 
         if jax.process_index() != 0:
             return
+
+        # surface background-write failures at the next create(): a
+        # writer-thread exception must not stay buried in a Future nobody
+        # joins. The failed entry is dropped (its file is unusable), then
+        # the error re-raises here.
+        for entry in list(self.checkpoints):
+            if entry.write_failed():
+                self.checkpoints = [c for c in self.checkpoints
+                                    if c is not entry]
+                entry.wait()  # re-raises the writer's exception
 
         epoch_int = epoch if epoch is not None else stage.data.epochs
         entry = CheckpointEntry(self.model_id, stage.index, epoch_int, step,
@@ -466,6 +601,52 @@ class CheckpointManager:
 
         self.checkpoints.append(entry)
         self.trim(n_best=self.keep_best, n_latest=self.keep_latest)
+
+
+def find_auto_resume(path, model=None, quarantine_corrupt=True, log=None):
+    """Discover the newest valid checkpoint under a directory tree.
+
+    The ``--resume auto`` engine: scans ``path`` recursively for
+    ``*.ckpt`` files (run directories, their ``checkpoints/`` subdirs,
+    emergency saves — anything), verifies each candidate's integrity,
+    and returns ``(file, Checkpoint)`` for the one furthest along by
+    ``(stage, epoch, step)`` (file mtime breaks ties). Corrupt files
+    are quarantined so the next scan doesn't re-read them; ``model``
+    restricts the search to checkpoints of one model id. Returns None
+    when nothing valid exists.
+    """
+    path = Path(path)
+    if not path.exists():
+        return None
+
+    candidates = [f for f in path.rglob("*.ckpt")
+                  if f.is_file() and not f.name.startswith(".")
+                  # non-finite post-mortem dumps hold poisoned state by
+                  # definition — never resume from one
+                  and f.name != "failed.ckpt"]
+    candidates.sort(key=lambda f: f.stat().st_mtime, reverse=True)
+
+    best = None
+    best_key = None
+    for file in candidates:
+        try:
+            chkpt = Checkpoint.load(file)
+        except CheckpointCorrupt as e:
+            if log is not None:
+                log.error(f"auto-resume: quarantining corrupt checkpoint: {e}")
+            if quarantine_corrupt:
+                quarantine(file)
+            continue
+        except (KeyError, TypeError, OSError):
+            continue  # some other .ckpt-named file; not ours
+        if model is not None and chkpt.model != model:
+            continue
+        it = chkpt.iteration
+        key = (it.stage, it.epoch if it.epoch is not None else -1, it.step,
+               file.stat().st_mtime)
+        if best_key is None or key > best_key:
+            best, best_key = (file, chkpt), key
+    return best
 
 
 def load_directory(path, compare) -> List[CheckpointManager]:

@@ -15,6 +15,7 @@ the scheduler tick, callbacks, and a scalar fetch (loss + finiteness).
 """
 
 import time
+from collections import deque
 from datetime import datetime
 from pathlib import Path
 from typing import Optional
@@ -26,8 +27,65 @@ from .. import telemetry, utils
 from ..parallel import (
     TrainState, batch_nbytes, make_train_step, replicate, shard_batch,
 )
+from ..testing import faults
 from .checkpoint import Checkpoint, Iteration, State
 from .spec import Stage, Strategy
+
+
+class NonFinitePolicy:
+    """What to do when a training step produces non-finite values.
+
+    ``raise`` (default) preserves the historical behavior: dump a
+    ``failed.ckpt`` and abort the run. ``skip`` compiles the
+    skip-step discipline of dynamic loss scaling (Micikevicius et al.,
+    *Mixed Precision Training*, 2018) into the train step: the poisoned
+    optimizer update is dropped on device (params/opt state carry
+    forward bit-identically) and training continues. ``rollback`` skips
+    like ``skip`` but restores the last valid checkpoint once trips
+    persist. Both escalate — ``max_consecutive`` consecutive tripped
+    steps, or more than ``max_consecutive`` trips within a trailing
+    ``window`` of steps, trigger the rollback (or, under ``skip`` /
+    when no checkpoint survives, the abort), and ``max_rollbacks``
+    bounds how often a rollback may fire before the run gives up.
+    """
+
+    POLICIES = ("raise", "skip", "rollback")
+
+    def __init__(self, policy="raise", max_consecutive=3, window=50,
+                 max_rollbacks=3):
+        if policy not in self.POLICIES:
+            raise ValueError(
+                f"invalid non-finite policy '{policy}', expected one of "
+                f"{list(self.POLICIES)}")
+        self.policy = policy
+        self.max_consecutive = max(1, int(max_consecutive))
+        self.window = max(1, int(window))
+        self.max_rollbacks = max(0, int(max_rollbacks))
+
+    @classmethod
+    def from_config(cls, cfg):
+        """``None`` | policy name | mapping with ``policy`` /
+        ``max-consecutive`` / ``window`` / ``max-rollbacks`` keys."""
+        if cfg is None:
+            return cls()
+        if isinstance(cfg, str):
+            return cls(cfg)
+        if isinstance(cfg, cls):
+            return cfg
+        return cls(
+            cfg.get("policy", "raise"),
+            cfg.get("max-consecutive", cfg.get("max_consecutive", 3)),
+            cfg.get("window", 50),
+            cfg.get("max-rollbacks", cfg.get("max_rollbacks", 3)),
+        )
+
+    def get_config(self):
+        return {
+            "policy": self.policy,
+            "max-consecutive": self.max_consecutive,
+            "window": self.window,
+            "max-rollbacks": self.max_rollbacks,
+        }
 
 
 def _device_prefetch(samples, put, depth=2, tele=None):
@@ -118,7 +176,7 @@ class TrainingContext:
     def __init__(self, log, path, strategy, model_id, model, model_adapter,
                  loss, input, inspector, checkpoints, mesh=None,
                  step_limit=None, loader_args={}, wire=None,
-                 eval_buckets=None):
+                 eval_buckets=None, nonfinite=None):
         self.root_log = log
         self.log = log
         self.path = Path(path)
@@ -141,6 +199,24 @@ class TrainingContext:
         # mixed-resolution validation sets batch per bucket and compile at
         # most one val-step program per bucket
         self.eval_buckets = eval_buckets
+
+        # non-finite step recovery policy (NonFinitePolicy); counters are
+        # reset per stage in run_stage
+        self.nonfinite = NonFinitePolicy.from_config(nonfinite)
+        self._nf_last_count = 0
+        self._nf_consecutive = 0
+        self._nf_window = deque()
+        self._nf_rollbacks = 0
+        # sample ids of recently dispatched batches — attached to
+        # nonfinite events so a trip is reproducible offline even though
+        # detection is amortized (up to _finite_every-1 steps late)
+        self._recent_samples = deque(maxlen=32)
+
+        # graceful-stop flag: set by the SIGTERM/SIGINT handlers (or
+        # request_stop); the loop finishes the in-flight step, writes an
+        # emergency checkpoint, and returns cleanly
+        self._stop = None
+        self._prev_handlers = {}
 
         self.validate = True
 
@@ -178,6 +254,70 @@ class TrainingContext:
 
     def opt_state(self):
         return self.state.opt_state if self.state is not None else {}
+
+    # -- preemption / graceful stop ----------------------------------------
+
+    def install_signal_handlers(self):
+        """Route SIGTERM/SIGINT into a graceful stop: the loop finishes
+        the in-flight step, writes an emergency checkpoint, and returns
+        cleanly (``--resume auto`` picks the run back up). The first
+        signal arms the stop and restores the previous handler, so a
+        second signal still kills a wedged run the hard way. Returns
+        False when handlers can't be installed (non-main thread)."""
+        import signal as _signal
+
+        for sig in (_signal.SIGTERM, _signal.SIGINT):
+            try:
+                self._prev_handlers[sig] = _signal.signal(sig, self._on_signal)
+            except ValueError:
+                self._prev_handlers.clear()
+                return False
+        return True
+
+    def _on_signal(self, signum, frame):
+        import signal as _signal
+
+        self.request_stop(_signal.Signals(signum).name)
+        prev = self._prev_handlers.pop(signum, None)
+        if prev is not None:
+            _signal.signal(signum, prev)
+
+    def request_stop(self, reason="request"):
+        """Arm the graceful stop (signal-handler and test entry point)."""
+        self._stop = reason
+
+    def _emergency_stop(self, log):
+        """Write the preemption checkpoint and log how to resume."""
+        reason = self._stop
+        tele = telemetry.get()
+        tele.emit("preempt", signal=str(reason), step=self.step,
+                  stage=getattr(self.current_stage, "index", None),
+                  epoch=self.current_epoch)
+
+        if jax.process_count() > 1 and jax.process_index() != 0:
+            log.warn(f"stop requested ({reason}): exiting (secondary process)")
+            return None
+
+        if self.train_variables() is None or self.current_stage is None:
+            log.warn(f"stop requested ({reason}) before training started: "
+                     "nothing to checkpoint")
+            return None
+
+        stage = self.current_stage
+        epoch = self.current_epoch if self.current_epoch is not None else 0
+        path_dir = Path(getattr(self.checkpoints, "path", None) or self.path)
+        path_dir.mkdir(parents=True, exist_ok=True)
+        path = path_dir / f"emergency-s{stage.index}_e{epoch}_b{self.step}.ckpt"
+
+        log.warn(f"stop requested ({reason}): writing emergency checkpoint "
+                 f"to '{path}'")
+        t0 = time.perf_counter()
+        self._snapshot_checkpoint(stage, epoch, source="emergency").save(path)
+        tele.emit("checkpoint", path=str(path), step=self.step,
+                  seconds=round(time.perf_counter() - t0, 4),
+                  source="emergency")
+        log.warn("emergency checkpoint written; resume with '--resume auto'")
+        return path
 
     # -- initialization ----------------------------------------------------
 
@@ -256,10 +396,19 @@ class TrainingContext:
             start_epoch = 0
             checkpoint = None
 
+            if self._stop:
+                break
             if self.step_limit is not None and self.step >= self.step_limit:
                 break
 
         self.log = self.root_log
+        if self._stop:
+            self._emergency_stop(self.log)
+            self.log.info(
+                f"training interrupted ({self._stop}) at step {self.step:,}; "
+                "state saved for auto-resume"
+            )
+            return
         self.log.info(
             f"training loop complete, ran {self.step:,} steps over {n_stages} stages"
         )
@@ -268,12 +417,15 @@ class TrainingContext:
         if self.strategy.mode != "best":
             return
 
-        chkpt = self.checkpoints.get_best(stage=stage.index - 1)
-        if chkpt is None:
+        # load_valid: a corrupt best checkpoint is quarantined and the
+        # next-best valid one used instead of aborting the stage handoff
+        found = self.checkpoints.load_valid(sort="best",
+                                            stage=stage.index - 1, log=log)
+        if found is None:
             return
 
-        log.info(f"loading best checkpoint from previous stage, file='{chkpt.path}'")
-        chkpt = chkpt.load()
+        entry, chkpt = found
+        log.info(f"loading best checkpoint from previous stage, file='{entry.path}'")
         self.variables, _, _ = chkpt.apply(variables=self.variables)
 
     def run_stage(self, log, stage: Stage, start_epoch=0, checkpoint=None):
@@ -398,6 +550,9 @@ class TrainingContext:
             loss_args=stage.loss_args, model_args=stage.model_args,
             external_lr=True, donate=True, with_grads=with_grads,
             wire=self.wire,
+            # skip/rollback compile the on-device skip guard into the
+            # step; raise keeps the unguarded update (NaNs absorbing)
+            nonfinite="skip" if self.nonfinite.policy != "raise" else None,
         )
 
         import os
@@ -405,6 +560,11 @@ class TrainingContext:
         self._accum = 0
         self._in_step = False
         self._pending_finite = None
+        # non-finite recovery bookkeeping: the device counter restarts at
+        # zero with the fresh TrainState, host mirrors follow
+        self._nf_last_count = 0
+        self._nf_consecutive = 0
+        self._nf_window.clear()
         # finite-check cadence (steps); 1 restores the check-every-step
         # behavior for debugging
         self._finite_every = max(
@@ -433,6 +593,8 @@ class TrainingContext:
 
             self.run_epoch(log_, stage, epoch)
 
+            if self._stop:
+                break
             if self.step_limit is not None and self.step >= self.step_limit:
                 break
 
@@ -440,6 +602,13 @@ class TrainingContext:
 
         # sync live variables out of the stage state
         self.variables = self.train_variables()
+
+        if self._stop:
+            # preemption: skip the stage-end validation sweep — the
+            # emergency checkpoint is the only artifact that matters now
+            telemetry.get().emit("stage_end", stage=stage.index,
+                                 step=self.step, interrupted=True)
+            return
 
         self.inspector.on_stage(log, self, stage)
         telemetry.get().emit("stage_end", stage=stage.index, step=self.step)
@@ -497,6 +666,8 @@ class TrainingContext:
 
             self.run_instance(log_, stage, epoch, i, host, dev, meta)
 
+            if self._stop:
+                break
             if self.step_limit is not None and self.step >= self.step_limit:
                 break
 
@@ -514,6 +685,13 @@ class TrainingContext:
                 log.info(f"mem: rss {snap['host_rss_gib']:.2f} GiB, "
                          f"live jax arrays {snap['live_arrays']}")
 
+        if self._stop:
+            # mid-epoch preemption: the epoch didn't complete, so neither
+            # the epoch schedulers nor the epoch-end validation sweep run
+            tele.emit("epoch_end", stage=stage.index, epoch=epoch,
+                      step=self.step, interrupted=True)
+            return
+
         for s in self.lr_sched_epoch:
             s.step()
 
@@ -525,9 +703,129 @@ class TrainingContext:
         """Resolve the deferred finite flag of the epoch's last step
         before validation/checkpointing can observe a poisoned state."""
         prev, self._pending_finite = self._pending_finite, None
-        if prev is not None and not bool(prev[0]):
-            self._dump_failed(log, prev[1], prev[2])
-            raise RuntimeError("non-finite flow values detected")
+        if prev is not None:
+            self._resolve_finite(log, prev,
+                                 "non-finite flow values detected")
+
+    def _resolve_finite(self, log, prev, msg):
+        """Apply the non-finite policy to one resolved finite fetch.
+
+        ``prev`` is ``(finite_flag, stage, epoch, nonfinite_count)`` as
+        staged by run_instance. Under ``raise`` this is the historical
+        dump-and-abort. Under ``skip``/``rollback`` the poisoned updates
+        were already dropped on device; here the host reads the
+        cumulative skip counter, emits the telemetry trail, and
+        escalates when trips persist (see NonFinitePolicy).
+        """
+        finite, stage, epoch, count = prev
+
+        if self.nonfinite.policy == "raise":
+            if not bool(finite):
+                self._dump_failed(log, stage, epoch)
+                raise RuntimeError(msg)
+            return
+
+        finite = bool(finite)
+        count = int(count) if count is not None else 0
+        trips = count - self._nf_last_count
+        self._nf_last_count = count
+
+        if trips <= 0:
+            self._nf_consecutive = 0
+            return
+
+        # consecutive estimate: exact at RMD_FINITE_CHECK_EVERY=1; at a
+        # larger cadence the latest step's flag decides whether the trip
+        # streak is still live
+        self._nf_consecutive = (self._nf_consecutive + trips if not finite
+                                else 0)
+        self._nf_window.append((self.step, trips))
+        horizon = self.step - self.nonfinite.window
+        while self._nf_window and self._nf_window[0][0] < horizon:
+            self._nf_window.popleft()
+        in_window = sum(t for _, t in self._nf_window)
+
+        samples = [{"step": s, "samples": ids}
+                   for s, ids in self._recent_samples]
+        telemetry.get().emit(
+            "nonfinite", step=self.step, stage=stage.index, epoch=epoch,
+            action="skip", trips=trips, consecutive=self._nf_consecutive,
+            window_trips=in_window, samples=samples,
+        )
+        log.warn(
+            f"non-finite step: dropped {trips} optimizer update(s) "
+            f"(policy '{self.nonfinite.policy}'; {in_window} trips in the "
+            f"last {self.nonfinite.window} steps)")
+
+        if (self._nf_consecutive < self.nonfinite.max_consecutive
+                and in_window <= self.nonfinite.max_consecutive):
+            return
+
+        if self.nonfinite.policy == "rollback":
+            self._rollback(log, stage, epoch)
+            return
+
+        self._dump_failed(log, stage, epoch)
+        raise RuntimeError(
+            f"non-finite steps persist under policy 'skip' "
+            f"({self._nf_consecutive} consecutive, {in_window} within "
+            f"{self.nonfinite.window} steps): aborting ({msg})")
+
+    def _rollback(self, log, stage, epoch):
+        """Restore the last valid checkpoint after persistent trips."""
+        self._nf_rollbacks += 1
+        if self._nf_rollbacks > self.nonfinite.max_rollbacks:
+            self._dump_failed(log, stage, epoch)
+            raise RuntimeError(
+                f"non-finite steps persist after "
+                f"{self.nonfinite.max_rollbacks} rollbacks: aborting")
+
+        found = (self.checkpoints.load_valid(sort="latest", log=log)
+                 if self.checkpoints is not None else None)
+        if found is None:
+            self._dump_failed(log, stage, epoch)
+            raise RuntimeError(
+                "non-finite steps persist and no valid checkpoint exists "
+                "to roll back to")
+
+        entry, chkpt = found
+        from_step = self.step
+        log.error(
+            f"non-finite steps persist: rolling back to '{entry.path}' "
+            f"(step {chkpt.iteration.step})")
+
+        try:
+            variables, opt_state, self.scaler = chkpt.apply(
+                variables=self.train_variables(),
+                opt_state=self.state.opt_state,
+                scaler=self.scaler,
+                lr_sched_inst=self.lr_sched_inst,
+                lr_sched_epoch=self.lr_sched_epoch,
+            )
+        except (KeyError, TypeError, ValueError):
+            # optimizer structure mismatch (checkpoint from another
+            # stage): weights-only restore, optimizer restarts fresh
+            log.warn("rollback checkpoint has incompatible optimizer "
+                     "state: restoring weights only")
+            variables, _, _ = chkpt.apply(variables=self.train_variables())
+            opt_state = self.tx.init(variables["params"])
+
+        self.state = self.state.replace(
+            params=variables["params"],
+            batch_stats=variables.get("batch_stats", {}),
+            opt_state=opt_state,
+        )
+        if self.mesh is not None:
+            self.state = replicate(self.state, self.mesh)
+        self.step = chkpt.iteration.step
+
+        self._nf_consecutive = 0
+        self._nf_window.clear()
+        telemetry.get().emit(
+            "nonfinite", step=self.step, stage=stage.index, epoch=epoch,
+            action="rollback", path=str(entry.path), from_step=from_step,
+            to_step=chkpt.iteration.step, rollbacks=self._nf_rollbacks,
+        )
 
     def run_instance(self, log, stage, epoch, i, host, dev, meta):
         accumulate = stage.gradient.accumulate
@@ -563,6 +861,24 @@ class TrainingContext:
             lr = s.lr()
         self.last_lr = lr
 
+        if faults.active():
+            if faults.fire("sigterm", step=self.step) is not None:
+                import os as _os
+                import signal as _signal
+
+                log.warn(f"fault injection: SIGTERM at step {self.step}")
+                _os.kill(_os.getpid(), _signal.SIGTERM)
+            if faults.fire("nan_update", step=self.step) is not None:
+                # NaN lr -> NaN update tree: the same poison a NaN
+                # gradient produces after the optimizer, without
+                # depending on model internals
+                log.warn(f"fault injection: NaN update at step {self.step}")
+                lr = float("nan")
+
+        self._recent_samples.append(
+            (self.step,
+             [f"{m.dataset_id}/{m.sample_id}" for m in meta]))
+
         self.inspector.on_batch_start(log, self, stage, epoch, i, img1, img2,
                                       flow, valid, meta)
 
@@ -583,18 +899,18 @@ class TrainingContext:
         # _flush_finite_check resolves the epoch's last step before
         # validation or checkpointing can observe the state.
         if self.validate:
-            self._pending_finite = (aux["finite"], stage, epoch)
+            self._pending_finite = (aux["finite"], stage, epoch,
+                                    aux.get("nonfinite_count"))
             if (i + 1) % self._finite_every == 0:
                 prev, self._pending_finite = self._pending_finite, None
                 t0 = time.perf_counter()
                 finite = bool(prev[0])
                 self._emit_device_sync(tele, time.perf_counter() - t0)
-                if not finite:
-                    self._dump_failed(log, prev[1], prev[2])
-                    raise RuntimeError(
-                        "non-finite flow values detected (flagged on a "
-                        "later step than the producing one; the state "
-                        "dump includes the poisoned updates)")
+                self._resolve_finite(
+                    log, (finite,) + prev[1:],
+                    "non-finite flow values detected (flagged on a "
+                    "later step than the producing one; the state "
+                    "dump includes the poisoned updates)")
         elif tele.enabled and (i + 1) % self._finite_every == 0:
             # validation disabled: the finite fetch (our usual free sync
             # point) never happens, so sample the pipeline drain
@@ -661,16 +977,11 @@ class TrainingContext:
         tele.emit("device_sync", step=self.step, seconds=round(drain, 6),
                   steps=steps, wall=round(wall, 6))
 
-    def _dump_failed(self, log, stage, epoch):
-        log.error("detected non-finite values in final flow field")
-        # auto-flushes the sink (nonfinite is a boundary event): the run
-        # is about to die and the JSONL must survive for the post-mortem
-        telemetry.get().emit("nonfinite", step=self.step, stage=stage.index,
-                             epoch=epoch)
-
+    def _snapshot_checkpoint(self, stage, epoch, source="training"):
+        """Full-state Checkpoint of the live context (host-side copy)."""
         from flax import serialization
 
-        chkpt = Checkpoint(
+        return Checkpoint(
             model=self.model_id,
             iteration=Iteration(stage.index, epoch, self.step),
             metrics=None,
@@ -682,12 +993,29 @@ class TrainingContext:
                     jax.tree.map(np.asarray, self.opt_state())
                 ),
                 scaler=dict(self.scaler or {}),
-                lr_sched_inst=[s.state_dict() for s in self.lr_sched_inst],
-                lr_sched_epoch=[s.state_dict() for s in self.lr_sched_epoch],
+                lr_sched_inst=[s.state_dict()
+                               for s in self.lr_sched_inst or []],
+                lr_sched_epoch=[s.state_dict()
+                                for s in self.lr_sched_epoch or []],
             ),
             metadata={
                 "timestamp": datetime.now().isoformat(),
-                "source": "training",
+                "source": source,
             },
         )
-        chkpt.save(self.path / "failed.ckpt")
+
+    def _dump_failed(self, log, stage, epoch):
+        log.error("detected non-finite values in final flow field")
+        # auto-flushes the sink (nonfinite is a boundary event): the run
+        # is about to die and the JSONL must survive for the post-mortem.
+        # The recent sample-id window makes the trip reproducible offline
+        # even though detection is amortized (the producing batch is one
+        # of the listed ones, at most _finite_every-1 steps back).
+        telemetry.get().emit(
+            "nonfinite", step=self.step, stage=stage.index, epoch=epoch,
+            action="raise",
+            samples=[{"step": s, "samples": ids}
+                     for s, ids in self._recent_samples],
+        )
+
+        self._snapshot_checkpoint(stage, epoch).save(self.path / "failed.ckpt")

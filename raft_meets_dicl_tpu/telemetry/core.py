@@ -47,11 +47,22 @@ SCHEMA = {
     "compile": {"label", "seconds"},
     "cache": {"event"},
     "memory": {"host_rss_gib", "live_arrays"},
+    # non-finite guard: "action" says what the policy did (raise | skip |
+    # rollback); skip/rollback events carry trip counts and the recent
+    # sample-id window so a trip is reproducible offline
     "nonfinite": {"step"},
     "checkpoint": {"path", "step", "seconds"},
     # one evaluation/validation sweep: samples/s, per-bucket batch and
     # compile counts, pad-waste ratio (see evaluation.EvalRunStats)
     "eval": {"name", "samples", "batches", "seconds"},
+    # fault-tolerance trail (PR 5): graceful-stop request (SIGTERM/SIGINT),
+    # --resume auto pickup, corrupt-checkpoint quarantine, decode-worker
+    # respawn, per-sample decode failure absorbed by the loader
+    "preempt": {"signal", "step"},
+    "resume": {"path", "step"},
+    "quarantine": {"path"},
+    "respawn": {"worker"},
+    "bad_sample": {"index"},
 }
 
 _FLUSH_EVERY = 128

@@ -166,11 +166,38 @@ def find_anomalies(events, warmup_steps=DEFAULT_WARMUP_STEPS,
 
     for e in events:
         if e["kind"] == "nonfinite":
+            action = e.get("action", "raise")
+            detail = f" ({e['trips']} update(s) dropped)" \
+                if action == "skip" and "trips" in e else ""
             flags.append(
-                f"non-finite guard tripped at step {e['step']}"
+                f"non-finite guard tripped at step {e['step']} "
+                f"[{action}]{detail}"
                 + (f" (stage {e['stage']})" if "stage" in e else ""))
+        elif e["kind"] == "quarantine":
+            flags.append(f"corrupt checkpoint quarantined: {e['path']}")
+        elif e["kind"] == "respawn":
+            flags.append(
+                f"decode worker {e['worker']} died "
+                f"(exit code {e.get('exitcode')}) and was respawned")
+        elif e["kind"] == "bad_sample":
+            flags.append(
+                f"sample {e['index']} failed to decode and was substituted"
+                + (f": {e['error']}" if "error" in e else ""))
+        elif e["kind"] == "preempt":
+            flags.append(
+                f"run preempted by {e['signal']} at step {e['step']} "
+                "(emergency checkpoint written)")
 
     return flags
+
+
+def fault_events(events):
+    """The run's fault-tolerance trail, in order: non-finite skips and
+    rollbacks, preemption stops, auto-resume pickups, checkpoint
+    quarantines, decode-worker respawns, absorbed bad samples."""
+    kinds = ("nonfinite", "preempt", "resume", "quarantine", "respawn",
+             "bad_sample")
+    return [e for e in events if e["kind"] in kinds]
 
 
 def eval_stats(events):
@@ -295,6 +322,43 @@ def render(events, errors=(), warmup_steps=DEFAULT_WARMUP_STEPS,
         misses = sum(1 for c in caches if c["event"] == "miss")
         lines.append(f"persistent compile cache: {hits} hits, "
                      f"{misses} misses")
+
+    fault = fault_events(events)
+    if fault:
+        lines.append("")
+        lines.append(f"== fault tolerance ({len(fault)} events) ==")
+        for e in fault:
+            kind = e["kind"]
+            if kind == "nonfinite":
+                action = e.get("action", "raise")
+                if action == "rollback":
+                    lines.append(
+                        f"  rollback at step {e.get('from_step', e['step'])}"
+                        f" -> step {e.get('to_step', '?')} "
+                        f"('{e.get('path', '?')}')")
+                elif action == "skip":
+                    lines.append(
+                        f"  skip at step {e['step']}: {e.get('trips', 1)} "
+                        f"update(s) dropped "
+                        f"({e.get('window_trips', '?')} in window)")
+                else:
+                    lines.append(f"  non-finite abort at step {e['step']}")
+            elif kind == "preempt":
+                lines.append(
+                    f"  preempt ({e['signal']}) at step {e['step']}")
+            elif kind == "resume":
+                lines.append(
+                    f"  resume from '{e['path']}' at step {e['step']}")
+            elif kind == "quarantine":
+                lines.append(f"  quarantined '{e['path']}'")
+            elif kind == "respawn":
+                lines.append(
+                    f"  respawned decode worker {e['worker']} "
+                    f"(exit code {e.get('exitcode')})")
+            elif kind == "bad_sample":
+                lines.append(
+                    f"  substituted bad sample {e['index']}"
+                    + (f" ({e['error']})" if "error" in e else ""))
 
     if memory:
         peak_rss = max(m["host_rss_gib"] for m in memory)

@@ -1,0 +1,5 @@
+"""Test-support utilities: fault injection for recovery-path testing."""
+
+from . import faults
+
+__all__ = ["faults"]

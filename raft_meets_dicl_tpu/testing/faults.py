@@ -1,0 +1,158 @@
+"""Fault injection for exercising the recovery paths end to end.
+
+Armed via ``RMD_FAULT``, a comma-separated list of directives::
+
+    RMD_FAULT="nan_update@step=3,sigterm@step=5"
+    RMD_FAULT="corrupt_checkpoint@nth=1;flips=16"
+    RMD_FAULT="kill_worker@index=2,decode_error@index=3;times=2"
+
+Each directive is ``name@key=value;key=value...``. Supported names and
+the call sites that consult them:
+
+``nan_update@step=N``
+    strategy.training.run_instance poisons the dispatched learning rate
+    with NaN at optimizer step N — the update tree goes NaN exactly like
+    a NaN-gradient batch would, tripping the non-finite guard.
+``sigterm@step=N``
+    strategy.training.run_instance delivers SIGTERM to the own process
+    at step N (mid-epoch preemption; the graceful-stop handler must
+    finish the step, write an emergency checkpoint, and exit cleanly).
+``corrupt_checkpoint@nth=K[;flips=B]``
+    strategy.checkpoint flips ``B`` bits (default 8) in the payload of
+    the K-th checkpoint written after arming (1-based) — the CRC verify
+    on load must catch it and quarantine the file.
+``kill_worker@index=I``
+    models.mpdecode worker processes hard-exit (``os._exit``) when asked
+    to decode sample index I — the pool must respawn the worker and
+    recover the lost in-flight work.
+``decode_error@index=I[;times=T]``
+    the sample pipeline raises on sample index I, T times (default 1) —
+    the loader's bounded retry / substitute path must absorb it.
+
+Firing is once per directive by default (``times`` raises the budget).
+Counters are per-process; when a fault must fire exactly once *across*
+processes (e.g. ``kill_worker`` in a decode pool, where the respawned
+worker re-decodes the same index), set ``RMD_FAULT_STATE`` to a shared
+directory — fired directives leave marker files there and every process
+honors them.
+
+Everything here is inert unless ``RMD_FAULT`` is set; the production
+call sites are single dict lookups on the parsed spec.
+"""
+
+import os
+import threading
+from pathlib import Path
+
+_lock = threading.Lock()
+# parsed spec cache: {spec string: [ (name, params dict), ... ]}
+_parsed = {}
+# per-process fire counts: {(name, param key): count}
+_fired = {}
+
+
+def _parse(spec):
+    directives = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, rest = part.partition("@")
+        params = {}
+        for kv in rest.split(";"):
+            if not kv:
+                continue
+            k, _, v = kv.partition("=")
+            try:
+                params[k.strip()] = int(v)
+            except ValueError:
+                params[k.strip()] = v.strip()
+        directives.append((name.strip(), params))
+    return directives
+
+
+def _directives():
+    spec = os.environ.get("RMD_FAULT", "")
+    if not spec:
+        return ()
+    with _lock:
+        if spec not in _parsed:
+            _parsed[spec] = _parse(spec)
+        return _parsed[spec]
+
+
+def active():
+    """Whether any fault directive is armed (cheap env check)."""
+    return bool(os.environ.get("RMD_FAULT"))
+
+
+def reset():
+    """Forget per-process fire counts (test isolation)."""
+    with _lock:
+        _fired.clear()
+        _parsed.clear()
+
+
+def _marker(name, params):
+    state = os.environ.get("RMD_FAULT_STATE")
+    if not state:
+        return None
+    key = "-".join(f"{k}{v}" for k, v in sorted(params.items()))
+    return Path(state) / f"fired-{name}-{key}"
+
+
+def fire(name, **match):
+    """Consume one firing of directive ``name`` if its parameters match.
+
+    ``match`` gives the call site's current coordinates (``step=``,
+    ``index=``, ``nth=``); a directive fires when every parameter it
+    pins (other than ``times``) equals the given value. Returns the
+    directive's params dict when it fires, else None.
+    """
+    if not active():
+        return None
+    for dname, params in _directives():
+        if dname != name:
+            continue
+        if any(params.get(k) != v for k, v in match.items() if k in params):
+            continue
+        times = params.get("times", 1)
+        key = (name, tuple(sorted(params.items())))
+        marker = _marker(name, params)
+        with _lock:
+            if marker is not None:
+                # cross-process once-only: the marker directory is the
+                # shared consumed-state (a respawned decode worker must
+                # not re-fire on the resubmitted sample)
+                try:
+                    marker.touch(exist_ok=False)
+                except FileExistsError:
+                    continue
+                except OSError:
+                    continue
+            else:
+                if _fired.get(key, 0) >= times:
+                    continue
+                _fired[key] = _fired.get(key, 0) + 1
+        return params
+    return None
+
+
+def corrupt_file(path, flips=8, offset=64):
+    """Flip ``flips`` bits spread across the file's payload region.
+
+    Deterministic (position-derived) so tests are reproducible; starts
+    at ``offset`` to land in the serialized payload rather than the
+    header magic, and clusters near the start so truncated/partial
+    reads also see the damage.
+    """
+    path = Path(path)
+    raw = bytearray(path.read_bytes())
+    if len(raw) <= offset:
+        offset = 0
+    span = max(1, len(raw) - offset)
+    for i in range(flips):
+        pos = offset + (i * 97) % span
+        raw[pos] ^= 1 << (i % 8)
+    path.write_bytes(bytes(raw))
+    return path

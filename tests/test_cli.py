@@ -107,10 +107,24 @@ validation:
     return root
 
 
+def _cli_env():
+    """Subprocess env for single-device CLI runs: drop the 8-virtual-
+    device XLA flag conftest sets for the parent test process — a child
+    inheriting it builds an 8-way data mesh and rejects batch size 1."""
+    import os
+    import re
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   env.get("XLA_FLAGS", "")).strip()
+    env["XLA_FLAGS"] = flags
+    return env
+
+
 def _cli(*args, cwd):
     proc = subprocess.run(
         [sys.executable, str(REPO / "main.py"), *args],
-        cwd=cwd, capture_output=True, text=True, timeout=900,
+        cwd=cwd, env=_cli_env(), capture_output=True, text=True, timeout=900,
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     return proc
@@ -145,6 +159,15 @@ def test_cli_train_eval_roundtrip(workspace):
     result = json.loads(report.read_text())
     assert len(result["samples"]) == 2
     assert "EndPointError/mean" in result["summary"]["mean"]
+
+    # incremental per-sample JSONL (crash-resilient partial results):
+    # written alongside -o, one flushed line per sample, same records
+    inc = workspace / "report.samples.jsonl"
+    assert inc.exists()
+    lines = [json.loads(line) for line in inc.read_text().splitlines()]
+    assert len(lines) == 2
+    assert lines[0]["id"] == result["samples"][0]["id"]
+    assert lines[0]["metrics"] == result["samples"][0]["metrics"]
 
     # gencfg → retrain from the full config
     full = workspace / "full.json"

@@ -1,0 +1,689 @@
+"""Fault-injection and recovery-path tests (testing.faults harness).
+
+Every recovery path the fault-tolerance layer promises is proven end to
+end here, CPU-only: checkpoint CRC verify + quarantine + fallback,
+non-finite skip/rollback policies (including bit-identical params across
+a skipped update), decode-worker respawn, per-sample retry/substitute,
+and the SIGTERM emergency save + auto-resume round trip.
+"""
+
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import raft_meets_dicl_tpu.models as models
+import raft_meets_dicl_tpu.strategy as strategy
+from raft_meets_dicl_tpu import telemetry
+from raft_meets_dicl_tpu.data.collection import (
+    Metadata, SampleArgs, SampleId,
+)
+from raft_meets_dicl_tpu.strategy.checkpoint import (
+    Checkpoint, CheckpointCorrupt, CheckpointEntry, Iteration, State,
+    find_auto_resume, quarantine,
+)
+from raft_meets_dicl_tpu.testing import faults
+from raft_meets_dicl_tpu.utils.logging import Logger
+from test_strategy import TINY_MODEL, _make_stage
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _fault_hygiene(monkeypatch):
+    """Every test starts unarmed, with a fresh memory telemetry sink and
+    the finite check at every step (deterministic trip detection)."""
+    monkeypatch.delenv("RMD_FAULT", raising=False)
+    monkeypatch.delenv("RMD_FAULT_STATE", raising=False)
+    monkeypatch.setenv("RMD_FINITE_CHECK_EVERY", "1")
+    faults.reset()
+    sink = telemetry.activate(telemetry.Telemetry())
+    yield sink
+    telemetry.deactivate()
+    faults.reset()
+
+
+def _events(sink, kind):
+    return [e for e in sink.events if e["kind"] == kind]
+
+
+# -- fixtures ----------------------------------------------------------------
+
+
+def _tiny_checkpoint(step=1, stage=0, epoch=0, model="tiny"):
+    rng = np.random.RandomState(step)
+    return Checkpoint(
+        model=model,
+        iteration=Iteration(stage, epoch, step),
+        metrics={"loss": float(step)},
+        state=State(
+            model={"params": {"w": rng.randn(8).astype(np.float32)}},
+            optimizer={},
+            scaler={},
+            lr_sched_inst=[],
+            lr_sched_epoch=[],
+        ),
+        metadata={"source": "test"},
+    )
+
+
+class SynthFlow:
+    """Tiny flow samples; consults the decode_error fault directive."""
+
+    def __init__(self, n=4, h=32, w=48):
+        self.n, self.h, self.w = n, h, w
+
+    def __getitem__(self, index):
+        if faults.fire("decode_error", index=index) is not None:
+            raise IOError(f"injected decode failure on sample {index}")
+        rng = np.random.RandomState(index)
+        base = rng.rand(self.h, self.w + 8, 3).astype(np.float32)
+        img1, img2 = base[:, :-8], base[:, 8:]
+        flow = np.zeros((self.h, self.w, 2), np.float32)
+        flow[..., 0] = 8.0
+        valid = np.ones((self.h, self.w), bool)
+        meta = Metadata(True, "synth",
+                        SampleId("s{i}", SampleArgs([], {"i": index}),
+                                 SampleArgs([], {"i": index + 1})),
+                        ((0, self.h), (0, self.w)))
+        return img1[None], img2[None], flow[None], valid[None], [meta]
+
+    def __len__(self):
+        return self.n
+
+    def get_config(self):
+        return {"type": "synth-flow", "n": self.n}
+
+    def description(self):
+        return "synth flow"
+
+
+def _make_context(tmp_path, nonfinite=None, epochs=1, step_limit=None,
+                  keep=2):
+    tmp_path = Path(tmp_path)
+    tmp_path.mkdir(parents=True, exist_ok=True)
+    spec = models.load(TINY_MODEL)
+    mgr = strategy.CheckpointManager(
+        "tiny", tmp_path / "checkpoints",
+        "{id_model}-s{n_stage}_e{n_epoch}_b{n_steps}.ckpt",
+        compare=["{m_loss}"], keep_best=keep, keep_latest=keep,
+    )
+    ctx = strategy.TrainingContext(
+        Logger("test"), tmp_path, strategy.Strategy(
+            "continuous", [_make_stage(epochs=epochs)]),
+        "tiny", spec.model, spec.model.get_adapter(), spec.loss, spec.input,
+        strategy.Inspector(), mgr, step_limit=step_limit,
+        loader_args={"num_workers": 0}, nonfinite=nonfinite,
+    )
+    return ctx, mgr
+
+
+# -- checkpoint integrity ----------------------------------------------------
+
+
+def test_checkpoint_crc_roundtrip(tmp_path):
+    ck = _tiny_checkpoint(step=5)
+    ck.save(tmp_path / "a.ckpt")
+    ld = Checkpoint.load(tmp_path / "a.ckpt")
+    assert ld.iteration.step == 5
+    np.testing.assert_array_equal(ld.state.model["params"]["w"],
+                                  ck.state.model["params"]["w"])
+
+
+def test_checkpoint_legacy_v1_still_loads(tmp_path):
+    from flax import serialization
+
+    from raft_meets_dicl_tpu.strategy import checkpoint as chk
+
+    ck = _tiny_checkpoint(step=3)
+    payload = serialization.msgpack_serialize(chk._to_host(ck.to_dict()))
+    (tmp_path / "v1.ckpt").write_bytes(chk._MAGIC_V1 + payload)
+    ld = Checkpoint.load(tmp_path / "v1.ckpt")
+    assert ld.iteration.step == 3
+
+
+def test_checkpoint_bitflip_detected_and_quarantined(tmp_path, _fault_hygiene):
+    p = tmp_path / "a.ckpt"
+    _tiny_checkpoint().save(p)
+    faults.corrupt_file(p)
+    with pytest.raises(CheckpointCorrupt):
+        Checkpoint.load(p)
+    moved = quarantine(p)
+    assert not p.exists()
+    assert moved.name == "a.ckpt.corrupt" and moved.exists()
+    ev = _events(_fault_hygiene, "quarantine")
+    assert ev and ev[0]["path"].endswith("a.ckpt")
+
+
+def test_checkpoint_truncation_detected(tmp_path):
+    p = tmp_path / "a.ckpt"
+    _tiny_checkpoint().save(p)
+    raw = p.read_bytes()
+    p.write_bytes(raw[: len(raw) // 2])
+    with pytest.raises(CheckpointCorrupt):
+        Checkpoint.load(p)
+
+
+def test_corrupt_checkpoint_fault_directive(tmp_path, monkeypatch):
+    monkeypatch.setenv("RMD_FAULT", "corrupt_checkpoint@nth=2")
+    faults.reset()
+    from raft_meets_dicl_tpu.strategy import checkpoint as chk
+
+    monkeypatch.setattr(chk, "_SAVES", 0)
+    _tiny_checkpoint(step=1).save(tmp_path / "a.ckpt")
+    _tiny_checkpoint(step=2).save(tmp_path / "b.ckpt")
+    Checkpoint.load(tmp_path / "a.ckpt")  # untouched
+    with pytest.raises(CheckpointCorrupt):
+        Checkpoint.load(tmp_path / "b.ckpt")
+
+
+def test_manager_falls_back_to_next_valid(tmp_path, _fault_hygiene):
+    mgr = strategy.CheckpointManager(
+        "m", tmp_path, "{id_model}-s{n_stage}_e{n_epoch}_b{n_steps}.ckpt",
+        compare=["{m_loss}"])
+    for step in (1, 2):
+        p = tmp_path / f"m-s0_e0_b{step}.ckpt"
+        _tiny_checkpoint(step=step, model="m").save(p)
+        mgr.checkpoints.append(
+            CheckpointEntry("m", 0, 0, step, {"loss": 1.0}, p))
+    faults.corrupt_file(tmp_path / "m-s0_e0_b2.ckpt")
+
+    entry, chkpt = mgr.load_valid(sort="latest", log=Logger("test"))
+    assert chkpt.iteration.step == 1
+    assert (tmp_path / "m-s0_e0_b2.ckpt.corrupt").exists()
+    assert len(mgr.checkpoints) == 1
+    assert _events(_fault_hygiene, "quarantine")
+
+
+def test_find_auto_resume_picks_furthest_valid(tmp_path):
+    (tmp_path / "runA").mkdir()
+    _tiny_checkpoint(step=2).save(tmp_path / "runA" / "x.ckpt")
+    (tmp_path / "runB").mkdir()
+    _tiny_checkpoint(step=7, epoch=1).save(tmp_path / "runB" / "y.ckpt")
+    # poisoned post-mortem dumps are never resume candidates
+    _tiny_checkpoint(step=99).save(tmp_path / "runB" / "failed.ckpt")
+
+    file, chkpt = find_auto_resume(tmp_path)
+    assert file.name == "y.ckpt"
+    assert chkpt.iteration.step == 7
+
+
+def test_find_auto_resume_quarantines_and_falls_back(tmp_path):
+    _tiny_checkpoint(step=3).save(tmp_path / "old.ckpt")
+    _tiny_checkpoint(step=9).save(tmp_path / "new.ckpt")
+    faults.corrupt_file(tmp_path / "new.ckpt")
+
+    file, chkpt = find_auto_resume(tmp_path)
+    assert file.name == "old.ckpt"
+    assert chkpt.iteration.step == 3
+    assert (tmp_path / "new.ckpt.corrupt").exists()
+    assert find_auto_resume(tmp_path / "does-not-exist") is None
+
+
+def test_background_write_failure_surfaces(tmp_path, monkeypatch):
+    """A writer-thread exception must mark the entry failed and re-raise
+    at the next wait()/create() instead of vanishing with the Future."""
+    import time
+
+    from raft_meets_dicl_tpu.strategy import checkpoint as chk
+
+    ctx, mgr = _make_context(tmp_path)
+    ctx._ensure_variables(ctx.strategy.stages[0])
+    stage = ctx.strategy.stages[0]
+    stage.index = 0
+
+    orig_write = chk._write_atomic
+
+    def boom(path, payload):
+        raise OSError("disk full (injected)")
+
+    # first create: write fails on the background thread
+    monkeypatch.setattr(chk, "_write_atomic", boom)
+    monkeypatch.setenv("RMD_ASYNC_CHECKPOINT", "1")
+    mgr.create(Logger("test"), ctx, stage, 0, 1, {"loss": 1.0})
+    failed = mgr.checkpoints[-1]
+    for _ in range(100):  # let the writer thread resolve the future
+        if failed.pending is None or failed.pending.done():
+            break
+        time.sleep(0.05)
+
+    # wait() surfaces it, marks the entry failed, queries skip it
+    with pytest.raises(RuntimeError, match="background checkpoint write"):
+        failed.wait()
+    assert failed.failed
+    assert mgr.get_latest() is None
+
+    # a fresh failed pending surfaces at the next create() instead
+    monkeypatch.setattr(chk, "_write_atomic", boom)
+    mgr.checkpoints = []
+    mgr.create(Logger("test"), ctx, stage, 0, 2, {"loss": 1.0})
+    entry = mgr.checkpoints[-1]
+    for _ in range(100):
+        if entry.pending is None or entry.pending.done():
+            break
+        time.sleep(0.05)
+    monkeypatch.setattr(chk, "_write_atomic", orig_write)
+    with pytest.raises(RuntimeError, match="background checkpoint write"):
+        mgr.create(Logger("test"), ctx, stage, 0, 3, {"loss": 1.0})
+    assert entry not in mgr.checkpoints
+
+
+# -- non-finite step recovery ------------------------------------------------
+
+
+def test_skip_guard_leaves_params_bit_identical():
+    """A poisoned update under the skip guard must not move a single bit
+    of params/opt state, and the device trip counter must advance."""
+    import jax
+    import optax
+
+    from raft_meets_dicl_tpu import parallel
+
+    spec = models.load(TINY_MODEL)
+    model, loss = spec.model, spec.loss
+    src = SynthFlow(1)
+    img1, img2, flow, valid, _ = src[0]
+
+    variables = model.init(jax.random.PRNGKey(0), img1, img2)
+    tx = optax.adam(1e-3)
+    state = parallel.TrainState.create(variables, tx)
+    step = parallel.make_train_step(model, loss, tx, external_lr=True,
+                                    donate=False, nonfinite="skip")
+
+    before = jax.device_get(state.params)
+    state, aux = step(state, float("nan"), img1, img2, flow, valid)
+    assert not bool(aux["finite"])
+    assert int(aux["nonfinite_count"]) == 1
+    after = jax.device_get(state.params)
+    for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+        np.testing.assert_array_equal(a, b)
+
+    # a clean step still applies and the counter holds
+    state, aux = step(state, 1e-3, img1, img2, flow, valid)
+    assert bool(aux["finite"])
+    assert int(aux["nonfinite_count"]) == 1
+    changed = any(
+        not np.array_equal(a, b)
+        for a, b in zip(jax.tree.leaves(before),
+                        jax.tree.leaves(jax.device_get(state.params))))
+    assert changed
+
+
+def test_training_skip_policy_continues(tmp_path, monkeypatch,
+                                        _fault_hygiene):
+    monkeypatch.setenv("RMD_FAULT", "nan_update@step=1")
+    faults.reset()
+    ctx, _ = _make_context(tmp_path, nonfinite="skip")
+    ctx.run()
+    assert ctx.step == 2  # the run completed despite the poisoned step
+
+    evs = [e for e in _events(_fault_hygiene, "nonfinite")
+           if e.get("action") == "skip"]
+    assert evs and evs[0]["trips"] == 1
+    # offending batch reproducible offline: sample ids attached
+    assert any(s["samples"] for s in evs[0]["samples"])
+
+
+def test_training_skip_policy_escalates(tmp_path, monkeypatch):
+    monkeypatch.setenv(
+        "RMD_FAULT", ",".join(f"nan_update@step={i}" for i in range(8)))
+    faults.reset()
+    ctx, _ = _make_context(
+        tmp_path, nonfinite={"policy": "skip", "max-consecutive": 2},
+        epochs=3)
+    with pytest.raises(RuntimeError, match="persist"):
+        ctx.run()
+    assert (Path(tmp_path) / "failed.ckpt").exists()
+
+
+def test_training_rollback_restores_checkpoint(tmp_path, monkeypatch,
+                                               _fault_hygiene):
+    monkeypatch.setenv("RMD_FAULT", "nan_update@step=2,nan_update@step=3")
+    faults.reset()
+    ctx, mgr = _make_context(
+        tmp_path,
+        nonfinite={"policy": "rollback", "max-consecutive": 2},
+        epochs=3)
+
+    # checkpoint after the first (clean) epoch, like a validation pass
+    orig = strategy.TrainingContext.run_epoch
+
+    def run_epoch(self, log, stage, epoch):
+        orig(self, log, stage, epoch)
+        if epoch == 0:
+            mgr.create(log, self, stage, epoch, self.step, {"loss": 1.0})
+
+    monkeypatch.setattr(strategy.TrainingContext, "run_epoch", run_epoch)
+    ctx.run()
+
+    rb = [e for e in _events(_fault_hygiene, "nonfinite")
+          if e.get("action") == "rollback"]
+    assert rb, "rollback must fire after persistent trips"
+    assert rb[0]["to_step"] == 2 and rb[0]["from_step"] >= rb[0]["to_step"]
+
+
+# -- preemption + auto-resume ------------------------------------------------
+
+
+def test_sigterm_emergency_save_and_auto_resume(tmp_path, monkeypatch,
+                                                _fault_hygiene):
+    monkeypatch.setenv("RMD_FAULT", "sigterm@step=1")
+    faults.reset()
+    ctx, _ = _make_context(tmp_path, epochs=2)
+    assert ctx.install_signal_handlers()
+    ctx.run()
+
+    # the in-flight step finished, then the run stopped cleanly
+    assert ctx._stop == "SIGTERM"
+    saved_step = ctx.step
+    assert saved_step < 4  # 2 epochs x 2 batches would be 4: stopped early
+
+    preempts = _events(_fault_hygiene, "preempt")
+    assert preempts and preempts[0]["signal"] == "SIGTERM"
+    emergency = [e for e in _events(_fault_hygiene, "checkpoint")
+                 if e.get("source") == "emergency"]
+    assert emergency
+
+    # --resume auto discovers the emergency save and resumes at its step
+    found = find_auto_resume(tmp_path, model="tiny")
+    assert found is not None
+    file, chkpt = found
+    assert "emergency" in file.name
+    assert chkpt.iteration.step == saved_step
+
+    ctx2, _ = _make_context(tmp_path, epochs=2)
+    ctx2.run(checkpoint=chkpt)
+    assert ctx2.step > saved_step  # continued from, not restarted
+
+
+def test_request_stop_without_signal(tmp_path):
+    ctx, _ = _make_context(tmp_path)
+    ctx.request_stop("TEST")
+    assert ctx._stop == "TEST"
+
+
+# -- self-healing input pipeline ---------------------------------------------
+
+
+def test_loader_retry_absorbs_transient_failure(monkeypatch,
+                                                _fault_hygiene):
+    from raft_meets_dicl_tpu.models.input import Loader
+
+    monkeypatch.setenv("RMD_FAULT", "decode_error@index=2;times=1")
+    faults.reset()
+    ld = Loader(SynthFlow(4, 8, 8), batch_size=2, num_workers=0, retries=2)
+    batches = list(ld)
+    assert sum(b[0].shape[0] for b in batches) == 4
+    assert ld._bad_samples == 0  # retry succeeded, no substitution
+
+
+def test_loader_substitutes_persistent_bad_sample(monkeypatch,
+                                                  _fault_hygiene):
+    from raft_meets_dicl_tpu.models.input import Loader
+
+    monkeypatch.setenv("RMD_FAULT", "decode_error@index=3;times=5")
+    faults.reset()
+    ld = Loader(SynthFlow(4, 8, 8), batch_size=2, num_workers=2, retries=1,
+                bad_sample_budget=4)
+    batches = list(ld)
+    # batch count and shapes unchanged: the bad sample was substituted
+    assert sum(b[0].shape[0] for b in batches) == 4
+    assert ld._bad_samples == 1
+    ev = _events(_fault_hygiene, "bad_sample")
+    assert ev and ev[0]["index"] == 3
+
+
+def test_loader_bad_sample_budget_aborts(monkeypatch):
+    from raft_meets_dicl_tpu.models.input import Loader
+
+    monkeypatch.setenv(
+        "RMD_FAULT",
+        ",".join(f"decode_error@index={i};times=99" for i in range(4)))
+    faults.reset()
+    ld = Loader(SynthFlow(4, 8, 8), batch_size=2, num_workers=0, retries=0,
+                bad_sample_budget=1)
+    with pytest.raises(RuntimeError, match="bad-sample budget"):
+        list(ld)
+
+
+def test_decode_pool_respawns_dead_worker(tmp_path, monkeypatch,
+                                          _fault_hygiene):
+    from raft_meets_dicl_tpu.models.input import Loader
+
+    monkeypatch.setenv("RMD_FAULT", "kill_worker@index=2")
+    monkeypatch.setenv("RMD_FAULT_STATE", str(tmp_path))
+    monkeypatch.setenv("RMD_LOADER_POLL", "0.2")
+    monkeypatch.setenv("RMD_LOADER_TIMEOUT", "60")
+    faults.reset()
+
+    ld = Loader(SynthFlow(6, 8, 8), batch_size=2, procs=2)
+    batches = list(ld)
+    assert sum(b[0].shape[0] for b in batches) == 6
+    ev = _events(_fault_hygiene, "respawn")
+    assert ev and ev[0]["exitcode"] == 17
+
+
+def test_decode_pool_worker_error_retried(tmp_path, monkeypatch):
+    from raft_meets_dicl_tpu.models.input import Loader
+
+    monkeypatch.setenv("RMD_FAULT", "decode_error@index=1;times=1")
+    monkeypatch.setenv("RMD_FAULT_STATE", str(tmp_path))
+    faults.reset()
+
+    ld = Loader(SynthFlow(4, 8, 8), batch_size=2, procs=2, retries=2)
+    batches = list(ld)
+    assert sum(b[0].shape[0] for b in batches) == 4
+    assert ld._bad_samples == 0
+
+
+def test_decode_pool_respawn_budget_exhausts(tmp_path, monkeypatch):
+    """max_respawns=0: the first worker death immediately exhausts the
+    budget and surfaces as PoolBroken (not a hang, not a retry)."""
+    from raft_meets_dicl_tpu.models.mpdecode import DecodePool, PoolBroken
+
+    monkeypatch.setenv("RMD_FAULT", "kill_worker@index=0")
+    monkeypatch.setenv("RMD_FAULT_STATE", str(tmp_path))
+    faults.reset()
+
+    pool = DecodePool(SynthFlow(6, 8, 8), procs=1, poll=0.2, timeout=60,
+                      max_respawns=0)
+    try:
+        with pytest.raises(PoolBroken, match="respawn budget"):
+            pool.result(pool.submit(0))
+    finally:
+        pool.shutdown()
+
+
+# -- telemetry schema + report -----------------------------------------------
+
+
+def test_fault_event_schema():
+    import time
+
+    from raft_meets_dicl_tpu.telemetry.core import (
+        SCHEMA_VERSION, validate_event,
+    )
+
+    def base(kind, **f):
+        return {"v": SCHEMA_VERSION, "t": time.time(), "kind": kind, **f}
+
+    validate_event(base("preempt", signal="SIGTERM", step=3))
+    validate_event(base("resume", path="a.ckpt", step=3))
+    validate_event(base("quarantine", path="a.ckpt"))
+    validate_event(base("respawn", worker=0, exitcode=17))
+    validate_event(base("bad_sample", index=3, error="IOError"))
+    validate_event(base("nonfinite", step=1, action="skip", trips=2))
+    with pytest.raises(ValueError):
+        validate_event(base("preempt", signal="SIGTERM"))  # missing step
+    with pytest.raises(ValueError):
+        validate_event(base("quarantine"))
+
+
+def test_report_renders_fault_events():
+    import time
+
+    from raft_meets_dicl_tpu.telemetry import report
+    from raft_meets_dicl_tpu.telemetry.core import SCHEMA_VERSION
+
+    def base(kind, **f):
+        return {"v": SCHEMA_VERSION, "t": time.time(), "kind": kind, **f}
+
+    events = [
+        base("nonfinite", step=4, action="skip", trips=1, window_trips=1),
+        base("nonfinite", step=6, action="rollback", from_step=6,
+             to_step=2, path="c.ckpt"),
+        base("preempt", signal="SIGTERM", step=8),
+        base("resume", path="e.ckpt", step=8),
+        base("quarantine", path="bad.ckpt"),
+        base("respawn", worker=1, exitcode=9),
+        base("bad_sample", index=5, error="IOError: nope"),
+    ]
+    text = report.render(events)
+    assert "fault tolerance" in text
+    for frag in ("skip at step 4", "rollback at step 6",
+                 "preempt (SIGTERM)", "resume from 'e.ckpt'",
+                 "quarantined 'bad.ckpt'", "respawned decode worker 1",
+                 "substituted bad sample 5"):
+        assert frag in text, frag
+
+    flags = report.find_anomalies(events)
+    assert any("quarantined" in f for f in flags)
+    assert any("respawned" in f for f in flags)
+    assert any("preempted" in f for f in flags)
+
+
+def test_nonfinite_policy_config_roundtrip():
+    from raft_meets_dicl_tpu.strategy.training import NonFinitePolicy
+
+    p = NonFinitePolicy.from_config(
+        {"policy": "rollback", "max-consecutive": 5, "window": 100})
+    assert (p.policy, p.max_consecutive, p.window) == ("rollback", 5, 100)
+    assert NonFinitePolicy.from_config(None).policy == "raise"
+    assert NonFinitePolicy.from_config("skip").policy == "skip"
+    assert p.get_config()["max-consecutive"] == 5
+    with pytest.raises(ValueError):
+        NonFinitePolicy("explode")
+
+
+def test_fault_directive_parsing(monkeypatch):
+    monkeypatch.setenv(
+        "RMD_FAULT", "nan_update@step=3,decode_error@index=2;times=2")
+    faults.reset()
+    assert faults.active()
+    assert faults.fire("nan_update", step=1) is None   # wrong step
+    assert faults.fire("nan_update", step=3) is not None
+    assert faults.fire("nan_update", step=3) is None   # consumed
+    assert faults.fire("decode_error", index=2) is not None
+    assert faults.fire("decode_error", index=2) is not None  # times=2
+    assert faults.fire("decode_error", index=2) is None
+
+
+def test_fault_marker_state_shared(tmp_path, monkeypatch):
+    monkeypatch.setenv("RMD_FAULT", "kill_worker@index=1")
+    monkeypatch.setenv("RMD_FAULT_STATE", str(tmp_path))
+    faults.reset()
+    assert faults.fire("kill_worker", index=1) is not None
+    faults.reset()  # a "new process" still sees the marker file
+    assert faults.fire("kill_worker", index=1) is None
+
+
+# -- CLI round trip (slow) ---------------------------------------------------
+
+
+@pytest.mark.slow
+def test_cli_sigterm_then_resume_auto(tmp_path):
+    """Full-process proof: SIGTERM mid-run exits cleanly with an
+    emergency checkpoint; a second invocation with --resume auto resumes
+    at the saved step."""
+    import json
+    import subprocess
+    import sys
+
+    repo = Path(__file__).parent.parent
+    ws = tmp_path
+
+    # minimal in-place workspace (one stage, no validation)
+    import cv2
+
+    from raft_meets_dicl_tpu.data import io as dio
+
+    scene = ws / "data/training/clean/alley_1"
+    flows = ws / "data/training/flow/alley_1"
+    scene.mkdir(parents=True)
+    flows.mkdir(parents=True)
+    rs = np.random.RandomState(0)
+    for i in range(1, 5):
+        cv2.imwrite(str(scene / f"frame_{i:04d}.png"),
+                    (rs.rand(64, 96, 3) * 255).astype(np.uint8))
+    for i in range(1, 4):
+        dio.write_flow_mb(str(flows / f"frame_{i:04d}.flo"),
+                          rs.randn(64, 96, 2).astype(np.float32))
+    (ws / "dsspec.yaml").write_text("""
+name: Fake Sintel
+id: fake-sintel
+path: ./data
+layout:
+  type: generic
+  images: 'training/{pass}/{scene}/frame_{idx:04d}.png'
+  flows: 'training/flow/{scene}/frame_{idx:04d}.flo'
+  key: '{scene}/frame_{idx:04d}'
+parameters:
+  pass:
+    values: [clean]
+    sub: pass
+""")
+    (ws / "data.yaml").write_text("type: dataset\nspec: ./dsspec.yaml\n")
+    (ws / "model.yaml").write_text("""
+name: RAFT tiny
+id: raft/tiny
+model:
+  type: raft/baseline
+  parameters: {corr-levels: 2, corr-radius: 2, corr-channels: 32,
+               context-channels: 16, recurrent-channels: 16}
+  arguments: {iterations: 2}
+loss:
+  type: raft/sequence
+input:
+  padding: {type: modulo, mode: zeros, size: [8, 8]}
+""")
+    (ws / "strategy.yaml").write_text("""
+mode: continuous
+stages:
+  - name: Stage 0
+    id: fake/s0
+    data: {epochs: 2, batch-size: 1, source: ./data.yaml}
+    optimizer: {type: adam-w, parameters: {lr: 0.0004}}
+""")
+
+    from test_cli import _cli_env
+
+    env = dict(_cli_env(), RMD_FAULT="sigterm@step=1",
+               RMD_FINITE_CHECK_EVERY="1")
+    proc = subprocess.run(
+        [sys.executable, str(repo / "main.py"), "train",
+         "-d", str(ws / "strategy.yaml"), "-m", str(ws / "model.yaml"),
+         "-o", str(ws / "runs")],
+        cwd=ws, env=env, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+    emergency = list((ws / "runs").rglob("emergency-*.ckpt"))
+    assert emergency, "SIGTERM must leave an emergency checkpoint"
+    saved = Checkpoint.load(emergency[0])
+
+    env2 = dict(_cli_env(), RMD_FINITE_CHECK_EVERY="1")
+    proc = subprocess.run(
+        [sys.executable, str(repo / "main.py"), "train",
+         "-d", str(ws / "strategy.yaml"), "-m", str(ws / "model.yaml"),
+         "-o", str(ws / "runs"), "--resume", "auto", "--limit-steps",
+         str(saved.iteration.step + 1)],
+        cwd=ws, env=env2, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+    # the resumed run's telemetry shows the resume event at the exact step
+    run_dirs = sorted((ws / "runs").iterdir())
+    evs = [json.loads(line)
+           for line in (run_dirs[-1] / "events.jsonl").read_text().splitlines()]
+    resumes = [e for e in evs if e["kind"] == "resume"]
+    assert resumes and resumes[0]["step"] == saved.iteration.step
+    starts = [e for e in evs if e["kind"] == "stage_start"]
+    assert starts and starts[0]["step"] == saved.iteration.step

@@ -330,6 +330,10 @@ def test_loader_procs_worker_error_propagates():
                 raise ValueError("bad sample")
             return _raw_sample()
 
-    loader = minput.Loader(Boom(), batch_size=1, procs=1)
+    # bad_sample_budget=0 disables the self-healing retry/substitute
+    # layer (tests/test_faults.py covers it): the worker's error must
+    # propagate to the consumer as-is
+    loader = minput.Loader(Boom(), batch_size=1, procs=1, retries=0,
+                           bad_sample_budget=0)
     with pytest.raises(ValueError, match="bad sample"):
         list(loader)
