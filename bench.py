@@ -461,6 +461,157 @@ def _bench_dicl():
     return result
 
 
+def _bench_spmd():
+    """SPMD scale-out benchmark (``BENCH_SPMD=1``): step time and
+    per-chip param/opt-state bytes across mesh shapes on the 8-device
+    virtual CPU topology — the replicated 1-D baseline ``(8,1)`` against
+    partitioned ``(4,2)`` / ``(2,4)`` meshes (params + Adam moments
+    sharded over ``model`` per parallel.partition's rules), plus in-step
+    gradient accumulation (``accumulate=2``). Re-execs itself onto a
+    virtual 8-device CPU backend when the current backend is smaller
+    (same trick as ``__graft_entry__.dryrun_multichip``). One cumulative
+    JSON line per measurement; consumers read the last."""
+    if jax.device_count() < 8:
+        import re
+        import subprocess
+        import sys
+
+        if os.environ.get("_BENCH_SPMD_CHILD"):
+            raise RuntimeError(
+                f"BENCH_SPMD child still sees {jax.device_count()} devices "
+                "— platform forcing failed")
+
+        repo = os.path.dirname(os.path.abspath(__file__))
+        env = dict(os.environ)
+        env["_BENCH_SPMD_CHILD"] = "1"
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                       env.get("XLA_FLAGS", ""))
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+        code = (
+            "import jax; jax.config.update('jax_platforms', 'cpu'); "
+            f"import sys; sys.path.insert(0, {repo!r}); "
+            "import bench; bench._bench_spmd()"
+        )
+        rc = subprocess.run([sys.executable, "-c", code], env=env,
+                            cwd=repo).returncode
+        if rc != 0:
+            raise RuntimeError(f"BENCH_SPMD subprocess failed (rc={rc})")
+        return None
+
+    import optax
+
+    import raft_meets_dicl_tpu.models as models
+    from raft_meets_dicl_tpu import parallel
+
+    batch, height, width, iters = 8, 64, 96, 2
+    steps = int(os.environ.get("BENCH_STEPS", "3"))
+    # elapsed budget: measurements run cheapest-signal-first and later
+    # configs are skipped (marked explicitly) rather than letting an
+    # external timeout kill the whole run — same discipline as
+    # dryrun_multichip's RMD_DRYRUN_BUDGET_S
+    budget_s = float(os.environ.get("BENCH_SPMD_BUDGET_S", "420"))
+    t_start = time.monotonic()
+
+    spec = models.load({
+        "name": "bench-spmd", "id": "bench-spmd",
+        "model": {"type": "raft/baseline", "parameters": {}},
+        "loss": {"type": "raft/sequence"}, "input": None,
+    })
+    model, loss = spec.model, spec.loss
+
+    variables = model.init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, height, width, 3)), jnp.zeros((1, height, width, 3)),
+        iterations=1)
+    tx = optax.chain(optax.clip_by_global_norm(1.0), optax.adamw(1e-4))
+
+    def measure(mesh_spec, accumulate=1):
+        # fresh fixed-seed data per measurement so the cross-mesh loss
+        # comparison is apples to apples
+        rng = np.random.RandomState(0)
+        mesh = parallel.make_mesh(mesh_spec)
+        part = parallel.Partitioner(mesh)
+        state = part.shard_state(parallel.TrainState.create(variables, tx))
+        step = parallel.make_train_step(
+            model, loss, tx, mesh=mesh, model_args={"iterations": iters},
+            state_sharding=part.state_shardings(state),
+            accumulate=accumulate, donate=False)
+
+        b = batch * accumulate
+        img1 = jnp.asarray(rng.rand(b, height, width, 3), jnp.float32)
+        img2 = jnp.asarray(rng.rand(b, height, width, 3), jnp.float32)
+        flow = jnp.asarray(rng.randn(b, height, width, 2), jnp.float32)
+        valid = jnp.ones((b, height, width), bool)
+        bt = parallel.shard_batch((img1, img2, flow, valid), mesh)
+
+        t0 = time.perf_counter()
+        state, aux = step(state, *bt)
+        float(aux["loss"])
+        warm = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, aux = step(state, *bt)
+        loss_val = float(aux["loss"])
+        dt = (time.perf_counter() - t0) / steps
+
+        rep = part.report(state)
+        return {
+            "mesh": rep["mesh"],
+            "accumulate": accumulate,
+            "loss": round(loss_val, 5),
+            "step_ms": round(dt * 1e3, 2),
+            "pairs_per_sec": round(b / dt, 3),
+            "warmup_s": round(warm, 2),
+            "params_mib_per_chip": round(
+                rep["params_bytes_per_chip"] / 2 ** 20, 3),
+            "opt_mib_per_chip": round(
+                rep["opt_bytes_per_chip"] / 2 ** 20, 3),
+            "params_mib_replicated": round(
+                rep["params_bytes_replicated"] / 2 ** 20, 3),
+            "opt_mib_replicated": round(
+                rep["opt_bytes_replicated"] / 2 ** 20, 3),
+            "params_sharded_leaves": rep["params_sharded_leaves"],
+        }
+
+    result = {
+        "metric": "spmd-mesh-shapes",
+        "backend": jax.default_backend(),
+        "devices": jax.device_count(),
+        "batch": batch, "height": height, "width": width,
+        "iterations": iters, "steps": steps,
+    }
+    slowest = 0.0
+    for key, mesh_spec, acc in (("mesh_8x1", (8, 1), 1),
+                                ("mesh_4x2", (4, 2), 1),
+                                ("mesh_2x4", (2, 4), 1),
+                                ("mesh_4x2_accum2", (4, 2), 2)):
+        elapsed = time.monotonic() - t_start
+        if result and elapsed + 1.5 * max(slowest, 30.0) > budget_s:
+            result[f"{key}_skipped"] = f"budget ({elapsed:.0f}s elapsed)"
+            print(json.dumps(result), flush=True)
+            continue
+        t0 = time.monotonic()
+        result[key] = measure(mesh_spec, acc)
+        slowest = max(slowest, time.monotonic() - t0)
+        print(json.dumps(result), flush=True)
+
+    base = result.get("mesh_8x1")
+    for key in ("mesh_4x2", "mesh_2x4"):
+        m = result.get(key)
+        if base is None or m is None:
+            continue
+        result[f"{key}_hbm_ratio"] = round(
+            (m["params_mib_per_chip"] + m["opt_mib_per_chip"])
+            / max(base["params_mib_per_chip"] + base["opt_mib_per_chip"],
+                  1e-9), 4)
+        result[f"{key}_loss_rel_diff"] = round(
+            abs(m["loss"] - base["loss"]) / max(abs(base["loss"]), 1e-9), 6)
+    print(json.dumps(result), flush=True)
+    return result
+
+
 def _bench_fault():
     """Fault-tolerance overhead (``BENCH_FAULT=1``): per-step cost of the
     non-finite recovery machinery. Measures the same synthetic training
@@ -509,6 +660,18 @@ def _bench_fault():
 
 
 def main():
+    if os.environ.get("BENCH_SPMD", "0") != "0":
+        # SPMD mesh-shape benchmark: replicated vs partitioned state,
+        # per-chip HBM + step time on the 8-device virtual CPU topology
+        from raft_meets_dicl_tpu.utils.compcache import (
+            enable_persistent_cache,
+        )
+        enable_persistent_cache()
+        from raft_meets_dicl_tpu import telemetry
+        telemetry.activate(telemetry.create())
+        _bench_spmd()
+        return
+
     if os.environ.get("BENCH_FAULT", "0") != "0":
         # non-finite guard overhead: unguarded vs skip-guarded train step
         from raft_meets_dicl_tpu.utils.compcache import (

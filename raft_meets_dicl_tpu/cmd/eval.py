@@ -42,12 +42,14 @@ def evaluate(args):
     jax.config.update("jax_default_device", devices[0])
 
     # multi-device selection shards the eval batch over a data mesh (the
-    # reference wraps eval in nn.DataParallel, src/cmd/eval.py:144-145)
+    # reference wraps eval in nn.DataParallel, src/cmd/eval.py:144-145);
+    # the mesh comes from the parallel layer so eval and train agree on
+    # device order and axis names
     mesh = None
     if len(devices) > 1:
-        from jax.sharding import Mesh
+        from .. import parallel
 
-        mesh = Mesh(np.asarray(devices), ("data",))
+        mesh = parallel.data_mesh(devices=devices)
         logging.info(f"evaluating data-parallel over {len(devices)} devices")
 
     # model (a full training config's model section is accepted too)

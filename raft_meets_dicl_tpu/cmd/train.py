@@ -41,12 +41,13 @@ class Environment:
             wire=cfg.get("wire"),
             eval=cfg.get("eval", {}),
             nonfinite=cfg.get("nonfinite"),
+            parallel=cfg.get("parallel", {}),
             debug_nans=cfg.get("jax", {}).get("debug-nans", False),
             deterministic=cfg.get("jax", {}).get("deterministic", False),
         )
 
     def __init__(self, loader_args={}, wire=None, eval={}, nonfinite=None,
-                 debug_nans=False, deterministic=False):
+                 parallel={}, debug_nans=False, deterministic=False):
         self.loader_args = dict(loader_args)
         # wire config: preset name ('f32'/'bf16'/'u8') or mapping with
         # images/flow/pack-valid keys (models.wire.WireFormat.from_config)
@@ -60,6 +61,10 @@ class Environment:
         # (strategy.training.NonFinitePolicy); --nonfinite and
         # RMD_NONFINITE override it
         self.nonfinite = nonfinite
+        # parallel section: SPMD scale-out — {mesh: 'D,M' | {data, model},
+        # accumulate: k}. --mesh/--accumulate and RMD_MESH/RMD_ACCUMULATE
+        # override it (parallel.parse_mesh_spec documents the mesh forms).
+        self.parallel = dict(parallel or {})
         self.debug_nans = debug_nans
         self.deterministic = deterministic
 
@@ -69,6 +74,7 @@ class Environment:
             "wire": self.wire,
             "eval": self.eval,
             "nonfinite": self.nonfinite,
+            "parallel": self.parallel,
             "jax": {
                 "debug-nans": self.debug_nans,
                 "deterministic": self.deterministic,
@@ -283,21 +289,51 @@ def _train(args):
         "environment": env.get_config(),
     })
 
-    # devices / mesh
+    # devices / mesh: --mesh > RMD_MESH > env 'parallel' section. Default
+    # is the 1-D data mesh over every selected device (pure batch
+    # parallelism, replicated params — the historical layout); 'D,M'
+    # builds the 2-D (data × model) mesh whose 'model' axis shards
+    # param/optimizer storage per parallel.partition's rules.
+    import os as _os
+
     import jax
 
     devices = select_devices(args.device, args.device_ids)
-    if len(devices) > 1:
-        mesh = parallel.data_mesh(devices=devices)
+    mesh_cfg = (getattr(args, "mesh", None)
+                or _os.environ.get("RMD_MESH")
+                or env.parallel.get("mesh"))
+    mesh_spec = parallel.parse_mesh_spec(mesh_cfg)
+    if len(devices) > 1 or (mesh_spec is not None
+                            and mesh_spec[0] * mesh_spec[1] > 1):
+        mesh = parallel.make_mesh(mesh_spec, devices=devices)
+        if parallel.process_count() > 1 and "model" in mesh.axis_names:
+            raise ValueError(
+                "--mesh with a model axis is single-process only for now "
+                "(sharded state save/restore is process-local)")
     else:
         # pin single-device runs to the selected device — without this the
         # jitted step would fall back to the default backend's device 0
         mesh = None
         jax.config.update("jax_default_device", devices[0])
-    logging.info(
-        f"devices: {len(devices)}× {devices[0].platform} "
-        f"({'SPMD data mesh' if mesh else 'single device'})"
-    )
+    if mesh is not None:
+        shape = ", ".join(f"{n}={mesh.shape[n]}" for n in mesh.axis_names)
+        logging.info(
+            f"devices: {len(devices)}× {devices[0].platform} "
+            f"(SPMD mesh: {shape})")
+    else:
+        logging.info(
+            f"devices: {len(devices)}× {devices[0].platform} "
+            "(single device)")
+
+    # in-step gradient accumulation: --accumulate > RMD_ACCUMULATE > env
+    # 'parallel' section; k microbatches per optimizer step inside the
+    # jitted train step (k× effective batch, one microbatch's HBM)
+    accumulate = int(getattr(args, "accumulate", None)
+                     or _os.environ.get("RMD_ACCUMULATE")
+                     or env.parallel.get("accumulate", 1) or 1)
+    if accumulate > 1:
+        logging.info(f"gradient accumulation: {accumulate} microbatches "
+                     "per optimizer step (in-step lax.scan)")
 
     # build inspector and checkpoint manager
     inspector, chkptm = inspc.build(model.id, path_out)
@@ -359,7 +395,7 @@ def _train(args):
         log, path_out, strat, model_id, model_spec, model_adapter, loss, input,
         inspector, chkptm, mesh=mesh, step_limit=args.steps,
         loader_args=loader_args, wire=wire, eval_buckets=eval_buckets,
-        nonfinite=nonfinite,
+        nonfinite=nonfinite, accumulate=accumulate,
     )
 
     if args.checkpoint:

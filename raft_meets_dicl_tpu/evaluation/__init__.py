@@ -64,7 +64,11 @@ def static_args_key(args):
     return tuple(parts)
 
 
-def _cache_key(model, model_args, mesh=None, wire=None):
+def _cache_key(model, model_args, mesh=None, wire=None,
+               variables_sharding=None):
+    if variables_sharding is not None:
+        # a sharding pytree has no stable value key; bypass the cache
+        return None
     args_key = static_args_key(model_args)
     if args_key is None:
         return None
@@ -164,26 +168,39 @@ def _real_pixels(meta, shape, samples):
     return total
 
 
-def make_eval_fn(model, model_args=None, mesh=None, wire=None):
+def make_eval_fn(model, model_args=None, mesh=None, wire=None,
+                 variables_sharding=None):
     """Jitted ``(variables, img1, img2) -> (raw_output, final_flow)``.
 
-    With ``mesh`` (a 1-D ``jax.sharding.Mesh`` over a ``data`` axis) the
-    step runs SPMD like the training step: variables replicated, batch
-    sharded on the leading axis (reference wraps eval in nn.DataParallel,
-    src/cmd/eval.py:144-145) — callers must pad batches to a multiple of
-    the mesh size (``evaluate`` does).
+    With ``mesh`` the step runs SPMD like the training step: the batch
+    shards on the leading axis over every mesh axis (reference wraps eval
+    in nn.DataParallel, src/cmd/eval.py:144-145) — callers must pad
+    batches to a multiple of the mesh size (``evaluate`` does). The
+    shardings come from ``parallel.partition`` — the same place the train
+    step gets them — so ``variables_sharding`` (e.g.
+    ``Partitioner.variables_sharding(variables)``) lets eval consume
+    model-sharded training params directly: they gather to replicated
+    inside the step.
 
     ``wire`` (models.wire.WireFormat) accepts compact-dtype un-normalized
     images and decodes + normalizes them on device.
     """
+    from ..parallel import partition
+
     model_args = dict(model_args or {})
-    key = _cache_key(model, model_args, mesh, wire)
+    key = _cache_key(model, model_args, mesh, wire, variables_sharding)
     if key is not None and key in _EVAL_FN_CACHE:
         return _EVAL_FN_CACHE[key]
 
     adapter = model.get_adapter()
+    gather = (mesh is not None and variables_sharding is not None
+              and partition.is_sharded(variables_sharding))
+    repl_one = partition.replicated(mesh) if mesh is not None else None
 
     def step(variables, img1, img2):
+        if gather:
+            variables = jax.lax.with_sharding_constraint(
+                variables, repl_one)
         if wire is not None:
             img1, img2, _, _ = wire.decode(img1, img2)
         out = model.apply(variables, img1, img2, train=False, **model_args)
@@ -193,11 +210,10 @@ def make_eval_fn(model, model_args=None, mesh=None, wire=None):
     if mesh is None:
         step = jax.jit(step)
     else:
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        repl = NamedSharding(mesh, P())
-        data = NamedSharding(mesh, P("data"))
-        step = jax.jit(step, in_shardings=(repl, data, data))
+        data = partition.data_sharding(mesh)
+        variables_in = (variables_sharding if variables_sharding is not None
+                        else partition.replicated(mesh))
+        step = jax.jit(step, in_shardings=(variables_in, data, data))
 
     # compile events in events.jsonl attribute to 'eval_step'; the raw
     # jit stays reachable via __wrapped__ (warmup_eval_fn uses it)
@@ -242,7 +258,8 @@ def warmup_eval_fn(eval_fn, variables, shapes, batch_size, wire=None,
 
 
 def evaluate(model, variables, data, model_args=None, show_progress=True,
-             eval_fn=None, mesh=None, wire=None, pad_to=None, stats=None):
+             eval_fn=None, mesh=None, wire=None, pad_to=None, stats=None,
+             variables_sharding=None):
     """Yield an ``EvalSample`` per dataset sample.
 
     ``data`` iterates batches ``(img1, img2, flow, valid, meta)`` in NHWC
@@ -269,7 +286,8 @@ def evaluate(model, variables, data, model_args=None, show_progress=True,
     """
     adapter = model.get_adapter()
     step = (eval_fn if eval_fn is not None
-            else make_eval_fn(model, model_args, mesh=mesh, wire=wire))
+            else make_eval_fn(model, model_args, mesh=mesh, wire=wire,
+                              variables_sharding=variables_sharding))
 
     if show_progress:
         data = utils.logging.progress(data, unit="batch", leave=False)

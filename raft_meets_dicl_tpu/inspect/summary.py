@@ -353,12 +353,17 @@ class StrategyValidation(Validation):
         samples = utils.logging.progress(data, unit="batch", leave=False, desc=desc)
 
         variables = ctx.train_variables()
-        if jax.process_count() > 1:
-            # params live as global-mesh-replicated arrays; localize them
-            # (committed to a local device, not host numpy — numpy leaves
-            # would re-upload per batch) so the process-local validation
-            # jit can't trip the partitioner into emitting global-mesh
-            # collectives the other processes would never join
+        part = getattr(ctx, "partitioner", None)
+        if jax.process_count() > 1 or (part is not None
+                                       and part.model_size > 1):
+            # params live as global-mesh (possibly model-sharded) arrays;
+            # localize them (committed to a local device, not host numpy
+            # — numpy leaves would re-upload per batch) so the
+            # process-local validation jit can't trip the partitioner
+            # into emitting global-mesh collectives the other processes
+            # would never join, and never computes on partially
+            # replicated layouts the val step's jit has no annotations
+            # for
             variables = jax.device_put(jax.device_get(variables),
                                        jax.local_devices()[0])
         ctx_m = metrics.MetricContext(lr=ctx.last_lr, params=variables["params"])

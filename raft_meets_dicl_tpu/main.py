@@ -109,6 +109,22 @@ def main():
                        help="decode the input pipeline in N worker "
                             "processes (shared-memory transport); 0 = "
                             "thread pool (also: RMD_LOADER_PROCS)")
+    train.add_argument("--mesh", metavar="DATA,MODEL",
+                       help="SPMD mesh shape: 'D,M' (e.g. '4,2') builds a "
+                            "2-D data×model mesh whose model axis shards "
+                            "param/optimizer storage (regex partition "
+                            "rules, parallel.partition); 'data' or unset "
+                            "keeps the 1-D replicated-params data mesh; "
+                            "D=-1 fills the remaining devices (also: "
+                            "RMD_MESH or the env config's 'parallel' "
+                            "section)")
+    train.add_argument("--accumulate", type=int, metavar="K",
+                       help="in-step gradient accumulation: scan K "
+                            "microbatches per optimizer step inside the "
+                            "jitted train step — K× effective batch at "
+                            "one microbatch's activation memory (also: "
+                            "RMD_ACCUMULATE or the env config's "
+                            "'parallel' section)")
 
     # subcommand: evaluate
     eval_ = subp.add_parser("evaluate", aliases=["e", "eval"], formatter_class=fmtcls,

@@ -1,30 +1,40 @@
-"""Distributed execution layer: device meshes, sharded train steps.
+"""Distributed execution layer: device meshes, partitioned train steps.
 
 The reference's entire parallelism story is single-process
 ``nn.DataParallel`` (src/cmd/train.py:183-184 — scatter the batch over
 GPUs, implicit NCCL). The TPU-native equivalent is SPMD over a
-``jax.sharding.Mesh``: annotate the batch with a ``data`` axis sharding,
-keep parameters replicated, and let XLA insert the gradient all-reduces
-over ICI. The same compiled program runs single-chip, one pod slice, or
-multi-host over DCN (with ``jax.distributed.initialize``) — there is no
-separate code path.
+``jax.sharding.Mesh``: annotate the batch with a sharded leading axis,
+place parameters per the partition rules, and let XLA insert the
+gradient all-reduces over ICI. The same compiled program runs
+single-chip, one pod slice, or multi-host over DCN (with
+``jax.distributed.initialize``) — there is no separate code path.
 
 Axes:
 - ``data``  — batch parallelism (the reference's DataParallel equivalent)
-- ``space`` — optional spatial sharding for the O(H²W²) correlation volume
-  at high resolution (the framework's long-context axis)
+- ``model`` — parameter/optimizer *storage* sharding (ZeRO-style): the
+  regex partitioner in ``partition.py`` maps the wide encoder and
+  update-block kernels (and their Adam moments) onto this axis; the
+  train step gathers them once per step and the batch still splits over
+  every device, so per-chip HBM shrinks without touching the proven
+  data-parallel compute graph. ``make_mesh((data, model))`` builds the
+  2-D mesh; ``model=1`` degenerates to the historical 1-D layout
+  bit-for-bit.
 """
 
 from .distributed import initialize, is_primary, process_count, process_index
 from .mesh import (
-    batch_nbytes, data_axis_size, data_mesh, replicate, set_data_axis_size,
+    batch_nbytes, data_axis_size, data_mesh, make_mesh, mesh_data_size,
+    parse_mesh_spec, replicate, scoped_data_axis_size, set_data_axis_size,
     shard_batch,
 )
+from .partition import DEFAULT_RULES, Partitioner, data_sharding, replicated
 from .train import TrainState, make_eval_step, make_train_step
 
 __all__ = [
-    "batch_nbytes", "data_axis_size", "data_mesh", "replicate",
-    "set_data_axis_size", "shard_batch",
+    "batch_nbytes", "data_axis_size", "data_mesh", "make_mesh",
+    "mesh_data_size", "parse_mesh_spec", "replicate",
+    "scoped_data_axis_size", "set_data_axis_size", "shard_batch",
+    "DEFAULT_RULES", "Partitioner", "data_sharding", "replicated",
     "TrainState", "make_eval_step", "make_train_step",
     "initialize", "is_primary", "process_count", "process_index",
 ]

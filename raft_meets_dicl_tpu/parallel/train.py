@@ -1,12 +1,19 @@
 """Sharded train/eval step builders.
 
-One jitted SPMD program: parameters replicated, batch sharded over the
-``data`` mesh axis. The loss is a global mean, so XLA's partitioner emits
-the psum/all-reduce over ICI by itself — the explicit NCCL choreography the
-reference delegates to ``nn.DataParallel`` doesn't exist here.
+One jitted SPMD program: the batch shards over the ``data`` mesh axis and
+the parameters live wherever the partitioner put them — fully replicated
+on the historical 1-D mesh, or sharded over ``model`` on a 2-D
+``(data × model)`` mesh (``parallel.partition``). The loss is a global
+mean, so XLA's partitioner emits the psum/all-reduce over ICI by itself —
+the explicit NCCL choreography the reference delegates to
+``nn.DataParallel`` doesn't exist here.
 
-Gradient clipping and accumulation are optax transforms configured by the
-strategy layer; this module only owns the step function shape.
+Gradient clipping is an optax transform configured by the strategy layer.
+Gradient accumulation has two forms: the legacy host-driven
+``optax.MultiSteps`` (k step calls per optimizer update), and the in-step
+``accumulate=k`` — a ``lax.scan`` over k microbatches summing gradients
+before one optimizer apply, which buys k× effective batch for one extra
+params-sized buffer instead of k× activation HBM.
 """
 
 from typing import Any
@@ -18,7 +25,8 @@ from flax import struct
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..telemetry import instrument_jit
-from .mesh import set_data_axis_size
+from . import partition
+from .mesh import scoped_data_axis_size
 
 
 def _with_data_axis(n, fn):
@@ -27,17 +35,24 @@ def _with_data_axis(n, fn):
     The model traces inside the first call of the jitted function, so the
     degree must be pinned around the call, not at build time — otherwise
     an interleaved unsharded trace (e.g. the inspector's process-local
-    validation jit) would read a stale value. Resets to 1 on exit so
-    unsharded traces always see the unsharded degree.
+    validation jit) would read a stale value. ``scoped_data_axis_size``
+    restores the enclosing scope's degree on exit, so nested/concurrent
+    step builds over different meshes can't leak into each other.
     """
 
     def wrapped(*args, **kwargs):
-        set_data_axis_size(n)
-        try:
+        with scoped_data_axis_size(n):
             return fn(*args, **kwargs)
-        finally:
-            set_data_axis_size(1)
 
+    inner_lower = getattr(fn, "lower", None)
+    if inner_lower is not None:
+        # AOT entry point: tracing happens inside lower(), so it needs
+        # the same scoped degree as a live call
+        def lower(*args, **kwargs):
+            with scoped_data_axis_size(n):
+                return inner_lower(*args, **kwargs)
+
+        wrapped.lower = lower
     return wrapped
 
 
@@ -74,7 +89,8 @@ class TrainState(struct.PyTreeNode):
 
 def make_train_step(model, loss_fn, tx, mesh=None, loss_args=None,
                     model_args=None, donate=True, external_lr=False,
-                    with_grads=False, wire=None, nonfinite=None):
+                    with_grads=False, wire=None, nonfinite=None,
+                    state_sharding=None, accumulate=1):
     """Build the jitted training step.
 
     Static per-stage configuration (``model_args``, ``loss_args``) is baked
@@ -86,8 +102,24 @@ def make_train_step(model, loss_fn, tx, mesh=None, loss_args=None,
     strategy layer's host-side schedulers drive it. Without it, ``tx`` must
     contain its own lr scaling.
 
-    With ``mesh``, input/output shardings are annotated: state replicated,
-    batch split on the leading axis over ``data``.
+    With ``mesh``, input/output shardings are annotated: the batch splits
+    on the leading axis over every mesh axis; the state follows
+    ``state_sharding`` — a ``TrainState``-shaped pytree of
+    ``NamedSharding``s from ``partition.Partitioner.state_shardings``
+    (None keeps the historical fully-replicated layout). A genuinely
+    sharded layout runs ZeRO-style: params all-gather to replicated for
+    the forward/backward, gradients reduce back onto the shards, and the
+    optimizer update stays shard-local — params and moments pay per-chip
+    HBM divided by the model-axis size at rest. ``donate`` keeps
+    donating the (possibly sharded) state buffers to their successors.
+
+    ``accumulate=k`` compiles in-step gradient accumulation: the step
+    takes a ``k·B`` batch, ``lax.scan``s over k microbatches of B
+    (summing gradients, chaining batch-stats updates), and applies ONE
+    optimizer update from the averaged gradients — k× effective batch at
+    one microbatch's activation memory. The batch's leading dim must be
+    divisible by k (and, under a mesh, each microbatch by the data-axis
+    size).
 
     ``with_grads`` adds the raw gradient pytree to ``aux`` for inspection
     (gradient-statistics metrics). Off by default: returning grads keeps a
@@ -114,23 +146,80 @@ def make_train_step(model, loss_fn, tx, mesh=None, loss_args=None,
     loss_args = dict(loss_args or {})
     model_args = dict(model_args or {})
     guard = nonfinite == "skip"
+    accumulate = max(1, int(accumulate))
 
-    def step(state, lr, img1, img2, flow, valid):
+    # gather-compute only when the layout actually shards something: the
+    # degenerate all-replicated sharding keeps the historical program
+    # (and its compiled artifact) bit-for-bit
+    gather = (mesh is not None and state_sharding is not None
+              and partition.is_sharded(state_sharding.params))
+    repl_one = partition.replicated(mesh) if mesh is not None else None
+    bspec = partition.batch_spec(mesh) if mesh is not None else None
+
+    def forward(params, batch_stats, img1, img2, flow, valid):
         if wire is not None:
             img1, img2, flow, valid = wire.decode(img1, img2, flow, valid)
 
-        def compute_loss(params):
+        def compute_loss(p):
             out, new_bs = model.apply(
-                {"params": params, "batch_stats": state.batch_stats},
+                {"params": p, "batch_stats": batch_stats},
                 img1, img2, train=True, **model_args,
             )
             result = model.get_adapter().wrap_result(out, img1.shape[1:3])
             l = loss_fn(model, result.output(), flow, valid, **loss_args)
             return l, (new_bs, result.final())
 
-        (loss, (new_bs, final)), grads = jax.value_and_grad(
-            compute_loss, has_aux=True
-        )(state.params)
+        return jax.value_and_grad(compute_loss, has_aux=True)(params)
+
+    def step(state, lr, img1, img2, flow, valid):
+        # ZeRO-style gather: one all-gather of the sharded params for the
+        # compute graph; XLA overlaps it with the first encoder convs
+        params = (jax.lax.with_sharding_constraint(state.params, repl_one)
+                  if gather else state.params)
+
+        if accumulate == 1:
+            (loss, (new_bs, final)), grads = forward(
+                params, state.batch_stats, img1, img2, flow, valid)
+        else:
+            # k microbatches through one scan: gradients sum into a
+            # params-sized accumulator, batch stats chain microbatch to
+            # microbatch (the same sequential update k separate steps
+            # would apply), finals stack so aux keeps the full-batch
+            # contract for the host-side metrics
+            def split(x):
+                x = x.reshape((accumulate, x.shape[0] // accumulate)
+                              + x.shape[1:])
+                if mesh is not None:
+                    x = jax.lax.with_sharding_constraint(
+                        x, NamedSharding(mesh, P(None, *bspec)))
+                return x
+
+            micro = jax.tree.map(split, (img1, img2, flow, valid))
+
+            def body(carry, mb):
+                bs, gsum, lsum = carry
+                (l, (new_bs, fin)), g = forward(params, bs, *mb)
+                gsum = jax.tree.map(jnp.add, gsum, g)
+                return (new_bs, gsum, lsum + l), fin
+
+            zeros = jax.tree.map(jnp.zeros_like, params)
+            (new_bs, gsum, lsum), finals = jax.lax.scan(
+                body,
+                (state.batch_stats, zeros, jnp.zeros((), jnp.float32)),
+                micro,
+            )
+            # each microbatch loss is a mean over its (equal-sized)
+            # slice, so the mean of means is the big-batch mean — and
+            # the averaged gradient sum is its gradient
+            grads = jax.tree.map(lambda g: g / accumulate, gsum)
+            loss = lsum / accumulate
+            final = finals.reshape((-1,) + finals.shape[2:])
+
+        if gather:
+            # reduce the gradients back onto the param shards; from here
+            # on the optimizer update is elementwise and shard-local
+            grads = jax.lax.with_sharding_constraint(
+                grads, state_sharding.params)
 
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         if external_lr:
@@ -194,33 +283,47 @@ def make_train_step(model, loss_fn, tx, mesh=None, loss_args=None,
             "train_step",
             jax.jit(public, donate_argnums=(0,) if donate else ()))
 
-    repl = NamedSharding(mesh, P())
-    data = NamedSharding(mesh, P("data"))
+    repl = partition.replicated(mesh)
+    data = partition.data_sharding(mesh)
+    state_in = state_sharding if state_sharding is not None else repl
     aux_shardings = {"loss": repl, "final": data, "finite": repl,
                      "nonfinite_count": repl}
     if with_grads:
-        aux_shardings["grads"] = repl
+        # gradients shard exactly like the parameters they differentiate
+        aux_shardings["grads"] = (state_sharding.params
+                                  if gather else repl)
 
-    in_shardings = (repl,) + (None,) * (n_lead - 1) + (data,) * 4
+    in_shardings = (state_in,) + (None,) * (n_lead - 1) + (data,) * 4
     return instrument_jit("train_step", _with_data_axis(
         mesh.devices.size,
         jax.jit(
             public,
             in_shardings=in_shardings,
-            out_shardings=(repl, aux_shardings),
+            out_shardings=(state_in, aux_shardings),
             donate_argnums=(0,) if donate else (),
         )))
 
 
-def make_eval_step(model, mesh=None, model_args=None, wire=None):
+def make_eval_step(model, mesh=None, model_args=None, wire=None,
+                   variables_sharding=None):
     """Build the jitted inference step returning the final flow.
 
     ``wire`` decodes compact-dtype images on device (see
-    ``make_train_step``); flow/valid never cross into the eval step.
+    ``make_train_step``). ``variables_sharding`` (a variables-shaped
+    pytree of ``NamedSharding``s, e.g. from
+    ``partition.Partitioner.variables_sharding``) lets the eval step
+    take model-sharded parameters directly — they gather to replicated
+    inside the step; None keeps them replicated.
     """
     model_args = dict(model_args or {})
 
+    gather = (mesh is not None and variables_sharding is not None
+              and partition.is_sharded(variables_sharding))
+    repl_one = partition.replicated(mesh) if mesh is not None else None
+
     def step(variables, img1, img2):
+        if gather:
+            variables = jax.lax.with_sharding_constraint(variables, repl_one)
         if wire is not None:
             img1, img2, _, _ = wire.decode(img1, img2)
         out = model.apply(variables, img1, img2, train=False, **model_args)
@@ -230,8 +333,11 @@ def make_eval_step(model, mesh=None, model_args=None, wire=None):
     if mesh is None:
         return instrument_jit("eval_step", jax.jit(step))
 
-    repl = NamedSharding(mesh, P())
-    data = NamedSharding(mesh, P("data"))
+    repl = partition.replicated(mesh)
+    data = partition.data_sharding(mesh)
+    variables_in = (variables_sharding if variables_sharding is not None
+                    else repl)
     return instrument_jit("eval_step", _with_data_axis(
         mesh.devices.size,
-        jax.jit(step, in_shardings=(repl, data, data), out_shardings=data)))
+        jax.jit(step, in_shardings=(variables_in, data, data),
+                out_shardings=data)))

@@ -25,7 +25,7 @@ import numpy as np
 
 from .. import telemetry, utils
 from ..parallel import (
-    TrainState, batch_nbytes, make_train_step, replicate, shard_batch,
+    Partitioner, TrainState, batch_nbytes, make_train_step, shard_batch,
 )
 from ..testing import faults
 from .checkpoint import Checkpoint, Iteration, State
@@ -176,7 +176,8 @@ class TrainingContext:
     def __init__(self, log, path, strategy, model_id, model, model_adapter,
                  loss, input, inspector, checkpoints, mesh=None,
                  step_limit=None, loader_args={}, wire=None,
-                 eval_buckets=None, nonfinite=None):
+                 eval_buckets=None, nonfinite=None, partitioner=None,
+                 accumulate=1):
         self.root_log = log
         self.log = log
         self.path = Path(path)
@@ -189,6 +190,20 @@ class TrainingContext:
         self.inspector = inspector
         self.checkpoints = checkpoints
         self.mesh = mesh
+        # the partitioner maps params/optimizer state onto the mesh
+        # (parallel.partition): replicated on the 1-D data mesh, sharded
+        # over 'model' on a 2-D mesh. Everything that places or annotates
+        # state asks it, so a layout change propagates everywhere at once.
+        self.partitioner = (partitioner if partitioner is not None
+                            else Partitioner(mesh) if mesh is not None
+                            else None)
+        # in-step gradient accumulation factor (make_train_step
+        # accumulate=k): the loader batches k·B samples, the step scans k
+        # microbatches of B and applies ONE optimizer update — k× the
+        # effective batch at one microbatch's activation HBM. Orthogonal
+        # to the per-stage optax.MultiSteps accumulation, which spreads
+        # microbatches over k host steps instead.
+        self.accumulate = max(1, int(accumulate))
         self.loader_args = dict(loader_args)
         # wire format (models.wire.WireFormat) for the host→device batch
         # transfer; bound to the input spec's clip/range per stage. None =
@@ -448,8 +463,11 @@ class TrainingContext:
             # rejects the global array with a partitioner traceback
             raise ValueError(
                 f"global batch size {batch_size} must be a multiple of the "
-                f"data-mesh device count ({self.mesh.devices.size})"
+                f"mesh device count ({self.mesh.devices.size})"
             )
+        # in-step accumulation: the loader hands the step k microbatches
+        # at once; each step call is one optimizer update over k·B
+        batch_size *= self.accumulate
         if n_proc > 1:
             if batch_size % n_proc:
                 raise ValueError(
@@ -534,8 +552,16 @@ class TrainingContext:
                     opt_state=opt_state,
                 )
 
+        state_sharding = None
         if self.mesh is not None:
-            self.state = replicate(self.state, self.mesh)
+            # place the fresh state per the partition rules (replicated on
+            # the 1-D mesh, params/moments sharded over 'model' on a 2-D
+            # one) and publish the per-chip HBM accounting
+            self.state = self.partitioner.shard_state(self.state)
+            state_sharding = self.partitioner.state_shardings(self.state)
+            telemetry.get().emit(
+                "sharding", step=self.step, stage=stage.index,
+                **self.partitioner.report(self.state))
 
         # stage hooks before building the step: freeze_batchnorm etc. are
         # baked into the compiled program
@@ -549,7 +575,8 @@ class TrainingContext:
             self.model, self.loss, self.tx, mesh=self.mesh,
             loss_args=stage.loss_args, model_args=stage.model_args,
             external_lr=True, donate=True, with_grads=with_grads,
-            wire=self.wire,
+            wire=self.wire, state_sharding=state_sharding,
+            accumulate=self.accumulate,
             # skip/rollback compile the on-device skip guard into the
             # step; raise keeps the unguarded update (NaNs absorbing)
             nonfinite="skip" if self.nonfinite.policy != "raise" else None,
@@ -816,7 +843,7 @@ class TrainingContext:
             opt_state=opt_state,
         )
         if self.mesh is not None:
-            self.state = replicate(self.state, self.mesh)
+            self.state = self.partitioner.shard_state(self.state)
         self.step = chkpt.iteration.step
 
         self._nf_consecutive = 0
@@ -927,10 +954,15 @@ class TrainingContext:
         # by their global offset; each process owns one contiguous stripe)
         with tele.span("host"):
             if self.mesh is not None and jax.process_count() > 1:
-                shards = sorted(aux["final"].addressable_shards,
-                                key=lambda s: s.index[0].start or 0)
+                # dedupe by batch offset: on a 2-D mesh a batch range can
+                # be materialized on more than one local device (model
+                # axis), and each copy must contribute exactly once
+                parts = {}
+                for s in aux["final"].addressable_shards:
+                    parts.setdefault(s.index[0].start or 0,
+                                     np.asarray(s.data))
                 aux = aux | {"final": np.concatenate(
-                    [np.asarray(s.data) for s in shards])}
+                    [parts[k] for k in sorted(parts)])}
 
             result = _StepResult(aux)
 

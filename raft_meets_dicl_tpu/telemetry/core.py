@@ -55,6 +55,11 @@ SCHEMA = {
     # one evaluation/validation sweep: samples/s, per-bucket batch and
     # compile counts, pad-waste ratio (see evaluation.EvalRunStats)
     "eval": {"name", "samples", "batches", "seconds"},
+    # SPMD state placement (PR 6): the mesh shape plus per-chip vs.
+    # replicated byte accounting for params and optimizer state
+    # (parallel.partition.Partitioner.report) — emitted once per stage
+    # when the training state is placed on the mesh
+    "sharding": {"mesh", "params_bytes_per_chip", "opt_bytes_per_chip"},
     # fault-tolerance trail (PR 5): graceful-stop request (SIGTERM/SIGINT),
     # --resume auto pickup, corrupt-checkpoint quarantine, decode-worker
     # respawn, per-sample decode failure absorbed by the loader
@@ -317,6 +322,11 @@ def instrument_jit(label, fn):
 
     wrapped.__wrapped__ = fn
     wrapped.telemetry_label = label
+    if hasattr(fn, "lower"):
+        # forward the AOT entry point so instrumented step builders stay
+        # lowerable (tests lower every model id; compile events from an
+        # explicit .lower().compile() are attributed to the bare 'jit')
+        wrapped.lower = fn.lower
     return wrapped
 
 

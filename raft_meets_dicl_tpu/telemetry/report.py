@@ -225,6 +225,27 @@ def eval_stats(events):
     return out
 
 
+def sharding_stats(events):
+    """Per-stage SPMD placement summaries from ``sharding`` events: mesh
+    shape and the per-chip vs. replicated byte accounting the partitioner
+    reported when it placed the training state (PR 6)."""
+    out = []
+    for e in events:
+        if e["kind"] != "sharding":
+            continue
+        out.append({
+            "stage": e.get("stage"),
+            "mesh": e.get("mesh", {}),
+            "params_per_chip": e["params_bytes_per_chip"],
+            "params_replicated": e.get("params_bytes_replicated", 0),
+            "opt_per_chip": e["opt_bytes_per_chip"],
+            "opt_replicated": e.get("opt_bytes_replicated", 0),
+            "params_sharded_leaves": e.get("params_sharded_leaves", 0),
+            "params_leaves": e.get("params_leaves", 0),
+        })
+    return out
+
+
 def _fmt_ms(seconds):
     try:
         return f"{seconds * 1e3:9.2f}"
@@ -290,6 +311,29 @@ def render(events, errors=(), warmup_steps=DEFAULT_WARMUP_STEPS,
             f"{dev['steps_covered']} sampled steps "
             f"({dev['samples']} syncs, mean drain "
             f"{dev['mean_drain'] * 1e3:.2f} ms)")
+
+    shardings = sharding_stats(events)
+    if shardings:
+        lines.append("")
+        lines.append("== sharding ==")
+        for s in shardings:
+            mesh = " × ".join(f"{k}={v}" for k, v in s["mesh"].items()) \
+                or "?"
+            stage = f"stage {s['stage']}" if s["stage"] is not None else "-"
+            mib = 2 ** 20
+
+            def ratio(per, full):
+                return f"{per / full * 100:.0f}%" if full else "-"
+
+            lines.append(
+                f"{stage:<10} mesh [{mesh}]  params "
+                f"{s['params_per_chip'] / mib:.1f} MiB/chip "
+                f"({ratio(s['params_per_chip'], s['params_replicated'])} of "
+                f"replicated), opt "
+                f"{s['opt_per_chip'] / mib:.1f} MiB/chip "
+                f"({ratio(s['opt_per_chip'], s['opt_replicated'])}), "
+                f"{s['params_sharded_leaves']}/{s['params_leaves']} "
+                "param tensors sharded")
 
     evals = eval_stats(events)
     if evals:

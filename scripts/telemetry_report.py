@@ -4,7 +4,8 @@
 Reads the ``events.jsonl`` a training run writes by default (or any file
 produced by ``raft_meets_dicl_tpu.telemetry``), validates every record
 against the versioned schema, prints per-phase step timing stats
-(mean/p95/max/share), compile + persistent-cache counts, memory
+(mean/p95/max/share), compile + persistent-cache counts, SPMD sharding
+placement (mesh shape, per-chip vs replicated param/opt bytes), memory
 watermarks, and flags anomalies: step-time spikes, recompiles after
 warmup, and non-finite-guard events.
 
