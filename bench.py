@@ -612,6 +612,172 @@ def _bench_spmd():
     return result
 
 
+def _bench_compile_child():
+    """One BENCH_COMPILE scenario, in a fresh process (jit caches are
+    process-local, so cold/warm can only be compared across processes).
+
+    ``_BENCH_COMPILE_CHILD`` selects the workload (``train`` | ``eval``);
+    the parent controls cache state via RMD_NO_COMPILE_CACHE /
+    RMD_COMPILE_CACHE / RMD_AOT / RMD_AOT_DIR. Prints one JSON line:
+    ``time_to_first_step_s`` is the step-warmup window — program build,
+    tracing, compilation or artifact load, first dispatch, sync — i.e.
+    exactly the cost the registry/AOT store addresses; ``setup_s``
+    (model load + init + data) and ``total_s`` give the full boot for
+    context.
+    """
+    mode = os.environ["_BENCH_COMPILE_CHILD"]
+
+    import optax
+
+    import raft_meets_dicl_tpu.models as models
+    from raft_meets_dicl_tpu import (
+        compile as programs, evaluation, parallel, telemetry,
+    )
+    from raft_meets_dicl_tpu.utils.compcache import enable_persistent_cache
+
+    enable_persistent_cache()
+    programs.enable_aot()
+    telemetry.activate(telemetry.create())
+
+    cpu = jax.default_backend() == "cpu"
+    if cpu:
+        batch, height, width, iters = 2, 64, 96, 4
+        params = {"corr-levels": 2, "corr-radius": 2, "corr-channels": 32,
+                  "context-channels": 16, "recurrent-channels": 16}
+    else:
+        batch = int(os.environ.get("BENCH_BATCH", "6"))
+        height = int(os.environ.get("BENCH_HEIGHT", "400"))
+        width = int(os.environ.get("BENCH_WIDTH", "720"))
+        iters = int(os.environ.get("BENCH_ITERS", "12"))
+        params = {"mixed-precision": True}
+
+    spec = models.load({
+        "name": "bench-compile", "id": "bench-compile",
+        "model": {"type": "raft/baseline", "parameters": params},
+        "loss": {"type": "raft/sequence"}, "input": None,
+    })
+    model, loss = spec.model, spec.loss
+
+    rng = np.random.RandomState(0)
+    t_boot = time.perf_counter()
+    if mode == "train":
+        img = jnp.asarray(rng.rand(batch, height, width, 3), jnp.float32)
+        flow = jnp.asarray(rng.randn(batch, height, width, 2), jnp.float32)
+        valid = jnp.ones((batch, height, width), bool)
+        variables = model.init(jax.random.PRNGKey(0), img[:1], img[:1],
+                               iterations=1)
+        tx = optax.chain(optax.clip_by_global_norm(1.0), optax.adamw(4e-4))
+        state = parallel.TrainState.create(variables, tx)
+        jax.block_until_ready(state.params)
+        t0 = time.perf_counter()
+        key = programs.ProgramKey(
+            kind="train_step", model="bench-compile",
+            flags=programs.flag_items(shape=(batch, height, width),
+                                      iterations=iters))
+        step = parallel.make_train_step(model, loss, tx,
+                                        model_args={"iterations": iters},
+                                        key=key)
+        state, aux = step(state, img, img, flow, valid)
+        float(aux["loss"])
+        prog = step
+    else:
+        # bucketed eval: warmup over two bucket shapes + one real batch
+        shapes = [(height, width), (height - 8, width - 16)]
+        variables = model.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((1, height, width, 3)), jnp.zeros((1, height, width, 3)),
+            iterations=1)
+        img = jnp.asarray(rng.rand(batch, height, width, 3), jnp.float32)
+        jax.block_until_ready(jax.tree.leaves(variables)[0])
+        t0 = time.perf_counter()
+        fn = evaluation.make_eval_fn(model, {"iterations": iters},
+                                     model_id="bench-compile")
+        evaluation.warmup_eval_fn(fn, variables, shapes, batch)
+        out = fn(variables, img, img)
+        jax.block_until_ready(out[1])
+        prog = fn
+    t_end = time.perf_counter()
+    tts = t_end - t0
+
+    tele = telemetry.get()
+    print(json.dumps({
+        "mode": mode,
+        "time_to_first_step_s": round(tts, 3),
+        "setup_s": round(t0 - t_boot, 3),
+        "total_s": round(t_end - t_boot, 3),
+        "compiles": prog.compiles,
+        "compile_s": round(prog.compile_seconds, 3),
+        "compile_events": tele.counts().get("compile", 0),
+        "cache_hits": sum(1 for e in getattr(tele, "events", ())
+                          if e["kind"] == "cache" and e["event"] == "hit"),
+        "aot_hits": prog.aot_hits,
+        "aot_saves": prog.aot_saves,
+        "aot_fallbacks": prog.aot_fallbacks,
+    }), flush=True)
+
+
+def _bench_compile():
+    """Cold-start benchmark (``BENCH_COMPILE=1``): time-to-first-step for
+    the train step and the bucketed eval path under three boot regimes —
+    (a) cold (no caches at all), (b) persistent-compile-cache warm
+    (tracing + cache lookup, no backend compile), (c) AOT warm
+    (deserialized executables, no tracing, zero compiles). Each regime
+    runs in a fresh subprocess against a temp cache/program directory; a
+    ``populate`` run in between fills both stores. One cumulative JSON
+    line per measurement; consumers read the last."""
+    import subprocess
+    import sys
+    import tempfile
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    tmp = tempfile.mkdtemp(prefix="bench-compile-")
+    cache_dir = os.path.join(tmp, "cache")
+    aot_dir = os.path.join(tmp, "programs")
+
+    def run_child(mode, scenario):
+        env = dict(os.environ)
+        env.pop("BENCH_COMPILE", None)
+        env["_BENCH_COMPILE_CHILD"] = mode
+        env["RMD_COMPILE_CACHE"] = cache_dir
+        env["RMD_AOT_DIR"] = aot_dir
+        if scenario == "cold":
+            env["RMD_NO_COMPILE_CACHE"] = "1"
+            env["RMD_AOT"] = "0"
+        elif scenario == "populate":
+            env["RMD_AOT"] = "1"
+        elif scenario == "warm_cache":
+            env["RMD_AOT"] = "0"
+        elif scenario == "aot":
+            env["RMD_AOT"] = "1"
+        code = (f"import sys; sys.path.insert(0, {repo!r}); "
+                "import bench; bench._bench_compile_child()")
+        proc = subprocess.run([sys.executable, "-c", code], env=env,
+                              cwd=repo, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"BENCH_COMPILE child ({mode}/{scenario}) failed:\n"
+                f"{proc.stderr[-2000:]}")
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    result = {"metric": "compile-cold-start",
+              "backend": jax.default_backend()}
+    for mode in ("train", "eval"):
+        m = {}
+        m["cold"] = run_child(mode, "cold")
+        print(json.dumps(result | {mode: m}), flush=True)
+        run_child(mode, "populate")  # fills compile cache + AOT store
+        m["warm_cache"] = run_child(mode, "warm_cache")
+        m["aot"] = run_child(mode, "aot")
+        cold = m["cold"]["time_to_first_step_s"]
+        m["speedup_warm_cache"] = round(
+            cold / max(m["warm_cache"]["time_to_first_step_s"], 1e-9), 2)
+        m["speedup_aot"] = round(
+            cold / max(m["aot"]["time_to_first_step_s"], 1e-9), 2)
+        result[mode] = m
+        print(json.dumps(result), flush=True)
+    return result
+
+
 def _bench_fault():
     """Fault-tolerance overhead (``BENCH_FAULT=1``): per-step cost of the
     non-finite recovery machinery. Measures the same synthetic training
@@ -660,6 +826,16 @@ def _bench_fault():
 
 
 def main():
+    if os.environ.get("_BENCH_COMPILE_CHILD"):
+        # one cold-start scenario delegated by the BENCH_COMPILE parent
+        _bench_compile_child()
+        return
+
+    if os.environ.get("BENCH_COMPILE", "0") != "0":
+        # cold vs persistent-cache-warm vs AOT-warm time-to-first-step
+        _bench_compile()
+        return
+
     if os.environ.get("BENCH_SPMD", "0") != "0":
         # SPMD mesh-shape benchmark: replicated vs partitioned state,
         # per-chip HBM + step time on the 8-device virtual CPU topology

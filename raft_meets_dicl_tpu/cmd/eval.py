@@ -33,6 +33,24 @@ def evaluate(args):
             f"choose one of {', '.join(FLOW_FORMATS)}"
         )
 
+    # telemetry (opt-in for eval: --telemetry PATH): the sweep's eval
+    # event, compile attribution, and the AOT hit/miss trail
+    from .. import compile as programs, telemetry
+    from ..utils import compcache
+
+    tele = telemetry.get()
+    if getattr(args, "telemetry", None):
+        tele = telemetry.activate(telemetry.create(Path(args.telemetry)))
+        if tele.path:
+            logging.info(f"writing telemetry events to '{tele.path}'")
+    tele.emit(
+        "boot",
+        compile_cache=compcache.effective_dir(),
+        aot_dir=str(programs.programs_dir()) if programs.aot_enabled()
+        else None,
+        aot=programs.aot_enabled(),
+    )
+
     # device selection (mirrors the train command)
     import jax
 
@@ -145,7 +163,11 @@ def evaluate(args):
     pad_to = args.batch_size if buckets is not None else None
     stats = evaluation.EvalRunStats(name="evaluate")
 
-    eval_fn = evaluation.make_eval_fn(model, None, mesh=mesh, wire=wire)
+    # stable model id: the program dedupes with any other builder of the
+    # same (model, bucket, wire) triple in this process (e.g. a training
+    # validation pass) and round-trips through the AOT store across boots
+    eval_fn = evaluation.make_eval_fn(model, None, mesh=mesh, wire=wire,
+                                      model_id=spec.id)
     if getattr(args, "precompile", False):
         if buckets is None or not buckets.sizes:
             raise ValueError(
@@ -236,6 +258,10 @@ def evaluate(args):
                 "samples": output,
                 "summary": collectors.results(),
             })
+
+    if getattr(args, "telemetry", None):
+        # flush + close the opt-in sink so the JSONL is complete on exit
+        telemetry.deactivate()
 
 
 def save_flow_image(dir, format, sample_id, img1, img2, target, valid, flow,

@@ -42,12 +42,14 @@ class Environment:
             eval=cfg.get("eval", {}),
             nonfinite=cfg.get("nonfinite"),
             parallel=cfg.get("parallel", {}),
+            compile=cfg.get("compile", {}),
             debug_nans=cfg.get("jax", {}).get("debug-nans", False),
             deterministic=cfg.get("jax", {}).get("deterministic", False),
         )
 
     def __init__(self, loader_args={}, wire=None, eval={}, nonfinite=None,
-                 parallel={}, debug_nans=False, deterministic=False):
+                 parallel={}, compile={}, debug_nans=False,
+                 deterministic=False):
         self.loader_args = dict(loader_args)
         # wire config: preset name ('f32'/'bf16'/'u8') or mapping with
         # images/flow/pack-valid keys (models.wire.WireFormat.from_config)
@@ -65,6 +67,11 @@ class Environment:
         # accumulate: k}. --mesh/--accumulate and RMD_MESH/RMD_ACCUMULATE
         # override it (parallel.parse_mesh_spec documents the mesh forms).
         self.parallel = dict(parallel or {})
+        # compile section: compiled-program cold-start knobs — {cache:
+        # DIR} repoints the persistent XLA compile cache, {aot: false}
+        # disables the AOT program store, {aot: DIR} relocates it.
+        # --compile-cache / RMD_COMPILE_CACHE / RMD_AOT* override it.
+        self.compile = dict(compile or {})
         self.debug_nans = debug_nans
         self.deterministic = deterministic
 
@@ -75,6 +82,7 @@ class Environment:
             "eval": self.eval,
             "nonfinite": self.nonfinite,
             "parallel": self.parallel,
+            "compile": self.compile,
             "jax": {
                 "debug-nans": self.debug_nans,
                 "deterministic": self.deterministic,
@@ -82,7 +90,29 @@ class Environment:
         }
 
     def apply(self):
+        import os
+
         import jax
+
+        # compile-cache / AOT-store config (lowest precedence: the CLI
+        # flag and RMD_* env vars were already applied at entry; only
+        # fill in what they left at the default). Runs before any
+        # backend use, like every other env flag here.
+        cache = self.compile.get("cache")
+        if (cache and not os.environ.get("RMD_COMPILE_CACHE")
+                and not os.environ.get("RMD_COMPILE_CACHE_DIR")):
+            from ..utils.compcache import enable_persistent_cache
+
+            enable_persistent_cache(str(cache))
+        aot = self.compile.get("aot")
+        if aot is not None and not os.environ.get("RMD_AOT_DIR"):
+            from .. import compile as programs
+
+            if aot is False:
+                programs.disable_aot()
+            elif programs.aot_enabled():
+                programs.enable_aot(
+                    None if aot is True else str(aot))
 
         if self.debug_nans:
             jax.config.update("jax_debug_nans", True)
@@ -237,6 +267,28 @@ def _train(args):
             Path(tele_path) if tele_path else path_out / "events.jsonl"))
         if tele.path:
             logging.info(f"writing telemetry events to '{tele.path}'")
+
+    # boot configuration event: the effective compile-cache and AOT
+    # program directories (instead of silently defaulting) plus the
+    # prefetch knob — the first thing a cold-start post-mortem needs
+    import os as _os
+
+    from .. import compile as programs
+    from ..utils import compcache
+
+    tele.emit(
+        "boot",
+        compile_cache=compcache.effective_dir(),
+        aot_dir=str(programs.programs_dir()) if programs.aot_enabled()
+        else None,
+        aot=programs.aot_enabled(),
+        prefetch=_os.environ.get("RMD_PREFETCH", "1") != "0",
+    )
+    if compcache.effective_dir():
+        logging.info(
+            f"persistent compile cache: '{compcache.effective_dir()}'")
+    if programs.aot_enabled():
+        logging.info(f"AOT program store: '{programs.programs_dir()}'")
 
     # seeds (apply() seeds host RNGs and yields the root jax key)
     if args.reproduce or args.seeds:

@@ -1,0 +1,347 @@
+"""Compiled-program registry: the single constructor for jitted steps.
+
+Before PR 7 every layer built its jitted step ad hoc — the training loop
+through ``parallel.make_train_step``, evaluation through ``make_eval_fn``
+plus its module-level cache, training-validation through a private jit in
+``inspect/summary.py`` — so the same (model, shape bucket, wire) triple
+could compile more than once per process and *always* recompiled per
+boot. The registry gives every step program one identity
+(:class:`ProgramKey`), one owner (:class:`Program` — lowering,
+compilation, AOT artifacts, warmup, per-program compile counters), and
+one dedupe point (:class:`ProgramRegistry`).
+
+Key discipline: a ProgramKey built only from *stable* configuration
+(model id string, config reprs, shapes) is content-addressable — equal
+across boots, so its programs can round-trip through the AOT artifact
+store (``aot.py``). Callers that cannot name their configuration exactly
+mark the key with a ``pyid:`` component (process-local object identity):
+such programs still dedupe within the process and still count compiles,
+but never touch the artifact store.
+"""
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Tuple
+
+from .. import telemetry
+from . import aot
+
+_UNSTABLE = "pyid:"
+
+# sentinel: this shape signature cannot use an AOT executable; stay on JIT
+_FALLBACK = object()
+
+
+def unstable(obj):
+    """Process-local identity marker for a key component that has no
+    stable serialization (keeps dedupe, disables AOT)."""
+    return f"{_UNSTABLE}{id(obj)}"
+
+
+def flag_items(**kwargs):
+    """Normalize keyword policy flags into the sorted (name, repr) tuple
+    a ProgramKey stores. Values must repr deterministically — the
+    ``evaluation.static_args_key`` discipline; callers pass
+    ``unstable(obj)`` for anything that doesn't."""
+    return tuple(sorted((k, repr(v)) for k, v in kwargs.items()))
+
+
+@dataclass(frozen=True)
+class ProgramKey:
+    """Identity of one compiled step program.
+
+    ``kind`` is the program family ('train_step', 'eval_step',
+    'val_loss', ...) — it doubles as the telemetry compile label.
+    ``model`` is the stable model id (or a ``pyid:`` marker). ``flags``
+    carries every policy that changes the traced computation: wire
+    format, mesh spec, nonfinite guard, accumulation, donation, static
+    model/loss args, stage config. Concrete input shapes are *not* part
+    of the key — one Program owns all shape buckets of its computation,
+    and the AOT store addresses artifacts by (key digest, shape
+    signature).
+    """
+
+    kind: str
+    model: str
+    flags: Tuple[Tuple[str, str], ...] = field(default=())
+
+    @property
+    def stable(self):
+        """Whether the key survives across processes (AOT-addressable)."""
+        if self.model.startswith(_UNSTABLE):
+            return False
+        return not any(_UNSTABLE in v for _, v in self.flags)
+
+    def canonical(self):
+        return repr((self.kind, self.model, self.flags))
+
+    def describe(self):
+        return f"{self.kind}[{self.model}]"
+
+
+def shape_signature(args):
+    """Concrete (shape, dtype) tuple over every array leaf of ``args`` —
+    the per-call index into a Program's compiled-executable family."""
+    import jax
+
+    parts = []
+    for leaf in jax.tree_util.tree_leaves(args):
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            parts.append((tuple(leaf.shape), str(leaf.dtype)))
+        else:
+            parts.append(type(leaf).__name__)
+    return tuple(parts)
+
+
+class Program:
+    """One registered step program: a jitted callable plus its identity,
+    compile counters, and (for stable keys) its AOT executable family.
+
+    Calls route through a per-shape-signature compiled executable when
+    the AOT store is enabled — loaded from disk when an artifact exists
+    (zero compiles), otherwise compiled ahead of time once and saved for
+    the next boot. Any mismatch (corrupt artifact, stale version,
+    incompatible input placement) falls back to the plain JIT path for
+    that signature, permanently and silently for the caller; the
+    telemetry trail records why.
+
+    ``compiles``/``compile_seconds`` count actual backend compiles
+    attributed to this program via the jax.monitoring listener — they
+    increment even when the telemetry sink is disabled, which is what
+    lets eval warmup report 0 compiles on a warm cache instead of
+    guessing 1 per shape (the pre-PR-7 overcount).
+    """
+
+    def __init__(self, key, fn, label=None):
+        self.key = key
+        self.label = label or key.kind
+        self._fn = fn
+        # compat with instrument_jit's wrapper contract
+        self.__wrapped__ = fn
+        self.telemetry_label = self.label
+        self.compiles = 0
+        self.compile_seconds = 0.0
+        self.aot_hits = 0
+        self.aot_misses = 0
+        self.aot_saves = 0
+        self.aot_fallbacks = 0
+        self._compiled = {}
+        self._lock = threading.Lock()
+        # callers may pin objects their pyid: key components reference so
+        # the ids stay unique for the program's lifetime
+        self._refs = ()
+
+    # -- counters (jax.monitoring listener callback) -----------------------
+
+    def record_compile(self, seconds):
+        self.compiles += 1
+        self.compile_seconds += seconds
+
+    # -- call paths --------------------------------------------------------
+
+    def lower(self, *args, **kwargs):
+        with telemetry.jit_label(self.label, self):
+            return self._fn.lower(*args, **kwargs)
+
+    def __call__(self, *args):
+        if self.key.stable and aot.aot_enabled():
+            sig = shape_signature(args)
+            entry = self._compiled.get(sig)
+            if entry is None:
+                entry = self._ensure(sig, args)
+            if entry is not _FALLBACK:
+                try:
+                    return entry(*args)
+                except Exception as e:  # noqa: BLE001 - input mismatch
+                    # argument checks run before execution, so the args
+                    # (donated included) are intact; pin this signature
+                    # to the JIT path and carry on
+                    self._compiled[sig] = _FALLBACK
+                    self.aot_fallbacks += 1
+                    self._emit("fallback",
+                               reason=f"call: {type(e).__name__}: "
+                                      f"{str(e)[:160]}")
+        with telemetry.jit_label(self.label, self):
+            return self._fn(*args)
+
+    def _ensure(self, sig, args):
+        """Resolve one shape signature: load its artifact, or compile
+        ahead of time and save one. Called once per (program, sig)."""
+        with self._lock:
+            entry = self._compiled.get(sig)
+            if entry is not None:
+                return entry
+
+            path = aot.artifact_path(self.key, sig)
+            if aot.tombstoned(path):
+                # a previous boot proved this executable doesn't survive
+                # serialization on this backend: plain JIT, no churn
+                self._compiled[sig] = _FALLBACK
+                return _FALLBACK
+            compiled, status, info = aot.load(path, self.key, sig)
+            if compiled is not None:
+                self.aot_hits += 1
+                self._emit("hit", bytes=info["bytes"],
+                           seconds=round(info["seconds"], 4))
+                self._compiled[sig] = compiled
+                return compiled
+
+            if status == "missing":
+                self.aot_misses += 1
+                self._emit("miss")
+            else:
+                # an artifact existed but was unusable: this boot pays a
+                # cold JIT it expected to skip — the anomaly the report
+                # flags
+                self.aot_fallbacks += 1
+                self._emit("fallback", reason=f"{status}: {info}")
+                if status == "error":
+                    # the artifact deserialized on save but not on load:
+                    # this executable doesn't round-trip on this backend
+                    # (e.g. XLA-CPU fusion symbol collisions). Tombstone
+                    # it so later boots take the JIT path silently
+                    # instead of re-saving and re-failing forever; the
+                    # marker is fingerprint-scoped, so a jax/backend
+                    # upgrade retries.
+                    try:
+                        os.remove(path)
+                    except OSError:
+                        pass
+                    aot.tombstone(path)
+
+            lower = getattr(self._fn, "lower", None)
+            if lower is None:
+                self._compiled[sig] = _FALLBACK
+                return _FALLBACK
+
+            c0 = self.compiles
+            try:
+                with telemetry.jit_label(self.label, self):
+                    compiled = lower(*args).compile()
+            except Exception as e:  # noqa: BLE001 - fall back to plain jit
+                self.aot_fallbacks += 1
+                self._emit("fallback",
+                           reason=f"compile: {type(e).__name__}: "
+                                  f"{str(e)[:160]}")
+                self._compiled[sig] = _FALLBACK
+                return _FALLBACK
+
+            if self.compiles == c0:
+                # the compile was served from the persistent XLA cache:
+                # no backend compile ran, and (on some backends) such
+                # executables serialize without their object code —
+                # writing them would poison the next boot. This boot is
+                # already warm through the cache; the artifact gets
+                # written by whichever boot pays the real compile.
+                self._emit("skip_save",
+                           reason="compile served from persistent cache")
+            else:
+                try:
+                    nbytes, seconds = aot.save(path, self.key, sig,
+                                               compiled)
+                    self.aot_saves += 1
+                    self._emit("save", bytes=nbytes,
+                               seconds=round(seconds, 4))
+                except Exception as e:  # noqa: BLE001 - save is cosmetic
+                    self._emit("fallback",
+                               reason=f"save: {type(e).__name__}: "
+                                      f"{str(e)[:160]}")
+
+            self._compiled[sig] = compiled
+            return compiled
+
+    def _emit(self, event, **fields):
+        telemetry.get().emit(
+            "aot", event=event, program=self.key.kind,
+            model=self.key.model, **fields)
+
+    def stats(self):
+        return {
+            "kind": self.key.kind,
+            "model": self.key.model,
+            "stable": self.key.stable,
+            "compiles": self.compiles,
+            "compile_seconds": round(self.compile_seconds, 3),
+            "aot_hits": self.aot_hits,
+            "aot_misses": self.aot_misses,
+            "aot_saves": self.aot_saves,
+            "aot_fallbacks": self.aot_fallbacks,
+            "signatures": len(self._compiled),
+        }
+
+
+class ProgramRegistry:
+    """Process-wide Program store: dedupe by key, bounded FIFO.
+
+    Evicting an entry only drops the registry's reference — callers
+    holding the Program keep a fully working step (same contract as the
+    old evaluation fn cache)."""
+
+    def __init__(self, max_programs=64):
+        self.max_programs = max_programs
+        self._programs = {}
+        self._anonymous = []
+        self._lock = threading.Lock()
+
+    def get(self, key):
+        with self._lock:
+            return self._programs.get(key)
+
+    def register(self, key, fn, label=None, dedupe=True):
+        telemetry.install_listeners()
+        with self._lock:
+            if dedupe:
+                existing = self._programs.get(key)
+                if existing is not None:
+                    return existing
+            program = Program(key, fn, label)
+            if dedupe:
+                while len(self._programs) >= self.max_programs:
+                    self._programs.pop(next(iter(self._programs)))
+                self._programs[key] = program
+            else:
+                self._anonymous.append(program)
+                del self._anonymous[:-self.max_programs]
+            return program
+
+    def programs(self):
+        with self._lock:
+            return list(self._programs.values()) + list(self._anonymous)
+
+    def stats(self):
+        return [p.stats() for p in self.programs()]
+
+    def clear(self):
+        with self._lock:
+            self._programs.clear()
+            self._anonymous.clear()
+
+
+_registry = ProgramRegistry()
+
+
+def registry():
+    """The process-wide registry."""
+    return _registry
+
+
+def reset():
+    """Drop every registered program (tests / bench cold runs)."""
+    _registry.clear()
+
+
+def register_step(kind, fn, key=None, label=None):
+    """Route one freshly built jitted step through the registry.
+
+    With a ``key`` the program dedupes (a second build of the same key
+    returns the first Program, jit closure discarded — check
+    ``registry().get(key)`` first to skip the build). Without one the
+    program is anonymous: tracked for stats and compile attribution,
+    never shared, never AOT'd — the safe default for callers whose
+    closures (optimizer, loss) have no stable identity.
+    """
+    if key is None:
+        key = ProgramKey(kind=kind, model=unstable(fn))
+        return _registry.register(key, fn, label or kind, dedupe=False)
+    return _registry.register(key, fn, label or key.kind, dedupe=True)

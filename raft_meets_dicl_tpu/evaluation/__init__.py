@@ -36,11 +36,14 @@ class EvalSample:
     meta: Any
 
 
-# jitted eval fns memoized per (model, args) so repeated evaluate() calls —
-# e.g. a validation pass every N training steps — hit the jit cache instead
-# of re-tracing the full forward pass each time. Bounded FIFO (evicting an
-# entry drops its closure + compiled executables) so long-lived processes
-# sweeping many models don't pin every one forever.
+# eval programs memoized per (model, args) so repeated evaluate() calls —
+# e.g. a validation pass every N training steps — hit the same registered
+# program instead of re-tracing the full forward pass each time. Bounded
+# FIFO (evicting an entry drops its closure + compiled executables) so
+# long-lived processes sweeping many models don't pin every one forever.
+# This is the fast in-module layer; cross-caller dedupe (training
+# validation vs the eval CLI, same (model, bucket, wire) triple) lives in
+# the process-wide compile.registry keyed by stable model id.
 _EVAL_FN_CACHE = {}
 _EVAL_FN_CACHE_MAX = 8
 
@@ -83,11 +86,11 @@ class EvalRunStats:
     """Aggregate accounting for one evaluation/validation sweep.
 
     Tracks batches/samples per dispatch shape ("bucket"), the number of
-    freshly compiled programs (distinct shapes, cross-checked against the
-    telemetry sink's compile events when one is active), and the
-    pad-waste ratio — the fraction of dispatched pixels that are padding
-    (modulo/bucket pad plus batch fill). ``emit`` publishes the ``eval``
-    event into the active telemetry sink.
+    freshly compiled programs (read from the registry Program's exact
+    per-program compile counter — 0 on warm jit/persistent/AOT caches),
+    and the pad-waste ratio — the fraction of dispatched pixels that are
+    padding (modulo/bucket pad plus batch fill). ``emit`` publishes the
+    ``eval`` event into the active telemetry sink.
     """
 
     name: str = "eval"
@@ -169,8 +172,9 @@ def _real_pixels(meta, shape, samples):
 
 
 def make_eval_fn(model, model_args=None, mesh=None, wire=None,
-                 variables_sharding=None):
-    """Jitted ``(variables, img1, img2) -> (raw_output, final_flow)``.
+                 variables_sharding=None, model_id=None):
+    """Registered eval program ``(variables, img1, img2) ->
+    (raw_output, final_flow)``.
 
     With ``mesh`` the step runs SPMD like the training step: the batch
     shards on the leading axis over every mesh axis (reference wraps eval
@@ -184,13 +188,48 @@ def make_eval_fn(model, model_args=None, mesh=None, wire=None,
 
     ``wire`` (models.wire.WireFormat) accepts compact-dtype un-normalized
     images and decodes + normalizes them on device.
+
+    ``model_id`` names the model stably (config id string): the program
+    then dedupes process-wide in the compile registry — the eval CLI, the
+    warmup pass, and training validation all get the *same* program for
+    the same (model, bucket, wire) triple — and, when the AOT store is
+    enabled, its per-shape executables round-trip through serialized
+    artifacts so a repeat boot compiles nothing. Without it the program
+    is keyed by object identity (process-local dedupe only).
     """
+    from .. import compile as programs
     from ..parallel import partition
 
     model_args = dict(model_args or {})
     key = _cache_key(model, model_args, mesh, wire, variables_sharding)
     if key is not None and key in _EVAL_FN_CACHE:
         return _EVAL_FN_CACHE[key]
+
+    def _cache(step):
+        if key is not None:
+            while len(_EVAL_FN_CACHE) >= _EVAL_FN_CACHE_MAX:
+                _EVAL_FN_CACHE.pop(next(iter(_EVAL_FN_CACHE)))
+            _EVAL_FN_CACHE[key] = step
+        return step
+
+    # registry identity: stable when the caller names the model and every
+    # policy component reprs exactly; otherwise pinned to this model
+    # object (the _refs reference keeps its id unique while cached)
+    pkey = None
+    args_key = static_args_key(model_args)
+    if args_key is not None and variables_sharding is None:
+        mesh_key = (None if mesh is None
+                    else tuple(d.id for d in mesh.devices.flat))
+        wire_key = None if wire is None else (
+            wire.images, wire.flow, wire.pack_valid, wire.clip, wire.range)
+        pkey = programs.ProgramKey(
+            kind="eval_step",
+            model=model_id or programs.unstable(model),
+            flags=programs.flag_items(
+                args=args_key, mesh=mesh_key, wire=wire_key))
+        existing = programs.registry().get(pkey)
+        if existing is not None:
+            return _cache(existing)
 
     adapter = model.get_adapter()
     gather = (mesh is not None and variables_sharding is not None
@@ -215,15 +254,32 @@ def make_eval_fn(model, model_args=None, mesh=None, wire=None,
                         else partition.replicated(mesh))
         step = jax.jit(step, in_shardings=(variables_in, data, data))
 
-    # compile events in events.jsonl attribute to 'eval_step'; the raw
-    # jit stays reachable via __wrapped__ (warmup_eval_fn uses it)
-    step = telemetry.instrument_jit("eval_step", step)
+    # registry Program: compile events attribute to 'eval_step', compiles
+    # count per-program (warmup/stats read them), AOT artifacts for
+    # stable keys; the raw jit stays reachable via __wrapped__
+    step = programs.register_step("eval_step", step, key=pkey)
+    step._refs = (model,)
 
-    if key is not None:
-        while len(_EVAL_FN_CACHE) >= _EVAL_FN_CACHE_MAX:
-            _EVAL_FN_CACHE.pop(next(iter(_EVAL_FN_CACHE)))
-        _EVAL_FN_CACHE[key] = step
-    return step
+    return _cache(step)
+
+
+def _program_compile_counter(step):
+    """Monotone compile counter for one step callable.
+
+    Registry Programs carry an exact per-program count (incremented by
+    the jax.monitoring listener on actual backend compiles, telemetry
+    sink or not). Legacy callables fall back to the sink's label-
+    qualified count, or — with no sink either — to a constant 0: never
+    the old first-seen-shape guess of 1, which overcounted every sweep
+    on a warm jit/persistent cache.
+    """
+    if hasattr(step, "compiles") and hasattr(step, "key"):
+        return lambda: step.compiles
+    tele = telemetry.get()
+    if tele.enabled:
+        label = getattr(step, "telemetry_label", "eval_step")
+        return lambda: tele.counts().get(f"compile:{label}", 0)
+    return lambda: 0
 
 
 def warmup_eval_fn(eval_fn, variables, shapes, batch_size, wire=None,
@@ -232,29 +288,33 @@ def warmup_eval_fn(eval_fn, variables, shapes, batch_size, wire=None,
     ``batch_size`` before the sweep touches real data.
 
     Runs the jitted step on zero-filled dummies (one forward per shape) so
-    the jit cache — and, where enabled, the persistent compile cache — is
-    hot when the first real batch of each bucket arrives: a KITTI-like
-    sweep then compiles nothing mid-epoch. Dummy images are created in
-    the wire image dtype when a ``wire`` format is active.
+    the jit cache — and, where enabled, the persistent compile cache and
+    AOT program store — is hot when the first real batch of each bucket
+    arrives: a KITTI-like sweep then compiles nothing mid-epoch. Dummy
+    images are created in the wire image dtype when a ``wire`` format is
+    active.
+
+    Warmup compiles are attributed through the registry Program's own
+    counter, which tracks actual backend compiles even with telemetry
+    disabled — so the sweep's ``compiles`` column reads 0 on a warm
+    jit/persistent/AOT cache instead of overcounting one per shape (the
+    pre-PR-7 fallback).
     """
     if wire is not None:
         dtype = wire.encode_image(np.zeros((1, 1, 1, 3), np.float32)).dtype
     else:
         dtype = np.float32
 
-    tele = telemetry.get()
+    counter = _program_compile_counter(eval_fn)
     for h, w in shapes:
         t0 = time.perf_counter()
-        c0 = tele.counts().get("compile:eval_step", 0) if tele.enabled else 0
+        c0 = counter()
         img = jnp.zeros((batch_size, int(h), int(w), 3), dtype)
         out = eval_fn(variables, img, img)
         jax.block_until_ready(out[1])
         if stats is not None:
             stats.add_phase("warmup", time.perf_counter() - t0)
-            stats.add_warmup(
-                (int(h), int(w)),
-                tele.counts().get("compile:eval_step", 0) - c0
-                if tele.enabled else 1)
+            stats.add_warmup((int(h), int(w)), counter() - c0)
 
 
 def evaluate(model, variables, data, model_args=None, show_progress=True,
@@ -292,8 +352,7 @@ def evaluate(model, variables, data, model_args=None, show_progress=True,
     if show_progress:
         data = utils.logging.progress(data, unit="batch", leave=False)
 
-    tele = telemetry.get()
-    seen_shapes = set()
+    counter = _program_compile_counter(step)
 
     def dispatch(item):
         img1, img2, flow, valid, meta = item
@@ -316,20 +375,13 @@ def evaluate(model, variables, data, model_args=None, show_progress=True,
 
         # compile accounting: the trace+compile happens synchronously
         # inside the step call, so a fresh dispatch shape that takes a
-        # compile is visible in the sink's labeled event-count delta
-        # (fallback without telemetry: first-seen shapes, which
-        # overcounts only on warm jit/persistent caches)
-        key = (target,) + tuple(j1.shape[1:3])
-        new_shape = key not in seen_shapes
-        seen_shapes.add(key)
-        c0 = tele.counts().get("compile:eval_step", 0) if tele.enabled else 0
+        # compile shows in the program's own counter delta — exact on
+        # warm jit/persistent/AOT caches, where the pre-PR-7 first-seen-
+        # shape fallback guessed 1 per shape
+        c0 = counter()
 
         out, final = step(variables, j1, j2)
-
-        compiles = 0
-        if new_shape:
-            compiles = (tele.counts().get("compile:eval_step", 0) - c0
-                        if tele.enabled else 1)
+        compiles = counter() - c0
 
         if stats is not None:
             stats.add_phase("dispatch", time.perf_counter() - t0)

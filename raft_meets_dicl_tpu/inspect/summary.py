@@ -254,11 +254,22 @@ class StrategyValidation(Validation):
         }
 
     def _val_step(self, ctx, stage):
-        """Memoized jitted (variables, batch) → (final flow, loss)."""
-        from ..evaluation import static_args_key
+        """Memoized (variables, batch) → (final flow, loss).
 
-        model_key = static_args_key(stage.model_args)
-        loss_key = static_args_key(stage.loss_args)
+        The forward pass is the SAME registered eval program the eval
+        CLI and the warmup path build (``evaluation.make_eval_fn`` +
+        compile registry, keyed by the stable model id): training
+        validation no longer compiles a duplicate forward for a (model,
+        bucket, wire) triple the process has already paid for, and a
+        warm AOT store covers it too. Only the loss reduction — a small
+        program over the forward's raw output — is validation-specific.
+        The returned callable exposes ``programs`` (forward, loss) so
+        the sweep's compile accounting reads exact per-program counters.
+        """
+        from .. import compile as programs, evaluation
+
+        model_key = evaluation.static_args_key(stage.model_args)
+        loss_key = evaluation.static_args_key(stage.loss_args)
         cacheable = model_key is not None and loss_key is not None
         key = (id(ctx.model), id(ctx.loss), model_key, loss_key)
         if cacheable and key in self._val_steps:
@@ -268,13 +279,43 @@ class StrategyValidation(Validation):
         model_args = dict(stage.model_args)
         loss_args = dict(stage.loss_args)
 
-        def step(variables, img1, img2, flow, valid):
-            out = model.apply(variables, img1, img2, train=False, **model_args)
-            result = model.get_adapter().wrap_result(out, img1.shape[1:3])
-            l = loss_fn(model, result.output(), flow, valid, **loss_args)
-            return result.final(), l
+        fwd = evaluation.make_eval_fn(
+            model, model_args, model_id=getattr(ctx, "model_id", None))
 
-        step = telemetry.instrument_jit("val_step", jax.jit(step))
+        lkey = None
+        if cacheable:
+            # loss identity: its config when it has one (stable — the
+            # val_loss program then AOT-round-trips like the forward),
+            # else pinned to the object (process-local dedupe only)
+            try:
+                loss_id = repr(loss_fn.get_config())
+            except Exception:  # noqa: BLE001 - config-less test stubs
+                loss_id = programs.unstable(loss_fn)
+            lkey = programs.ProgramKey(
+                kind="val_loss",
+                model=getattr(ctx, "model_id", None)
+                or programs.unstable(model),
+                flags=programs.flag_items(
+                    args=loss_key, model_args=model_key, loss=loss_id))
+            lprog = programs.registry().get(lkey)
+        else:
+            lprog = None
+        if lprog is None:
+            def lstep(out, flow, valid):
+                result = model.get_adapter().wrap_result(
+                    out, flow.shape[1:3])
+                return loss_fn(model, result.output(), flow, valid,
+                               **loss_args)
+
+            lprog = programs.register_step("val_loss", jax.jit(lstep),
+                                           key=lkey)
+            lprog._refs = (model, loss_fn)
+
+        def step(variables, img1, img2, flow, valid):
+            out, final = fwd(variables, img1, img2)
+            return final, lprog(out, flow, valid)
+
+        step.programs = (fwd, lprog)
 
         if cacheable:
             self._val_steps[key] = step
@@ -370,8 +411,13 @@ class StrategyValidation(Validation):
 
         from ..evaluation import EvalRunStats
         stats = EvalRunStats(name=f"validation:{val.name}")
-        tele = telemetry.get()
-        seen_shapes = set()
+        # compile accounting: exact per-program counters from the
+        # registered forward + loss programs (no first-seen-shape guess,
+        # no overcount on warm caches)
+        progs = getattr(step, "programs", ())
+
+        def compile_count():
+            return sum(p.compiles for p in progs)
 
         for i, (img1, img2, flow, valid, meta) in enumerate(samples):
             batch = img1.shape[0]
@@ -387,11 +433,7 @@ class StrategyValidation(Validation):
                 valid = np.concatenate(
                     [valid, np.zeros((pad,) + valid.shape[1:], bool)])
 
-            key = img1.shape[:3]
-            new_shape = key not in seen_shapes
-            seen_shapes.add(key)
-            c0 = (tele.counts().get("compile:val_step", 0)
-                  if tele.enabled else 0)
+            c0 = compile_count()
 
             est, loss = step(
                 variables, jnp.asarray(img1), jnp.asarray(img2),
@@ -399,10 +441,7 @@ class StrategyValidation(Validation):
             )
             est, loss = jax.device_get((est, loss))
 
-            compiles = 0
-            if new_shape:
-                compiles = (tele.counts().get("compile:val_step", 0) - c0
-                            if tele.enabled else 1)
+            compiles = compile_count() - c0
             stats.add_batch(
                 img1.shape[1:3], batch, pad,
                 sum((m.original_extents[0][1] - m.original_extents[0][0])

@@ -26,9 +26,6 @@ from . import cmd
 
 
 def main():
-    from .utils.compcache import enable_persistent_cache
-    enable_persistent_cache()
-
     def fmtcls(prog):
         return argparse.HelpFormatter(prog, max_help_position=42)
 
@@ -97,6 +94,13 @@ def main():
     train.add_argument("--telemetry", metavar="PATH",
                        help="telemetry JSONL sink path "
                             "[default: <run-dir>/events.jsonl]")
+    train.add_argument("--compile-cache", metavar="DIR",
+                       help="persistent XLA compile cache directory "
+                            "(also: RMD_COMPILE_CACHE; "
+                            "RMD_NO_COMPILE_CACHE=1 disables) "
+                            "[default: <repo>/.jax_cache]. The AOT "
+                            "program store lives in DIR/programs "
+                            "(RMD_AOT=0 disables, RMD_AOT_DIR relocates)")
     train.add_argument("--no-telemetry", action="store_true",
                        help="disable run telemetry "
                             "(equivalent to RMD_TELEMETRY=0)")
@@ -182,6 +186,15 @@ def main():
     eval_.add_argument("--precompile", action="store_true",
                        help="compile every declared bucket shape before "
                             "the sweep (requires explicit --buckets sizes)")
+    eval_.add_argument("--compile-cache", metavar="DIR",
+                       help="persistent XLA compile cache directory "
+                            "(also: RMD_COMPILE_CACHE) "
+                            "[default: <repo>/.jax_cache]; AOT program "
+                            "store in DIR/programs (RMD_AOT=0 disables)")
+    eval_.add_argument("--telemetry", metavar="PATH",
+                       help="write sweep telemetry events (eval stats, "
+                            "compile attribution, AOT hits/misses) to "
+                            "this JSONL file")
 
     # subcommand: checkpoint
     chkpt = subp.add_parser("checkpoint", formatter_class=fmtcls,
@@ -219,6 +232,21 @@ def main():
                        help="environment config")
 
     args = parser.parse_args()
+
+    # persistent compile cache + AOT program store: configured after
+    # parsing (--compile-cache wins over RMD_COMPILE_CACHE over the
+    # default) but before any backend use
+    import os
+
+    from . import compile as programs
+    from .utils.compcache import enable_persistent_cache
+
+    if getattr(args, "compile_cache", None):
+        # export so lower-precedence config (the env file's 'compile'
+        # section) can see the flag won
+        os.environ["RMD_COMPILE_CACHE"] = args.compile_cache
+    enable_persistent_cache(getattr(args, "compile_cache", None))
+    programs.enable_aot()
 
     commands = {
         "checkpoint": cmd.checkpoint,

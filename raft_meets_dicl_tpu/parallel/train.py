@@ -24,7 +24,7 @@ import optax
 from flax import struct
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..telemetry import instrument_jit
+from ..compile import register_step
 from . import partition
 from .mesh import scoped_data_axis_size
 
@@ -90,8 +90,8 @@ class TrainState(struct.PyTreeNode):
 def make_train_step(model, loss_fn, tx, mesh=None, loss_args=None,
                     model_args=None, donate=True, external_lr=False,
                     with_grads=False, wire=None, nonfinite=None,
-                    state_sharding=None, accumulate=1):
-    """Build the jitted training step.
+                    state_sharding=None, accumulate=1, key=None):
+    """Build the jitted training step, registered as a compiled program.
 
     Static per-stage configuration (``model_args``, ``loss_args``) is baked
     in — a new stage builds a new step function, recompiling as the
@@ -142,6 +142,14 @@ def make_train_step(model, loss_fn, tx, mesh=None, loss_args=None,
     The default (None) keeps the unguarded update: NaNs are absorbing
     through the optimizer state, which is what the ``raise`` policy's
     amortized trip detection relies on.
+
+    ``key`` (a ``compile.ProgramKey``) registers the step under a stable
+    identity — deduped in the process-wide registry and, when the AOT
+    store is enabled, round-tripped through serialized executables so a
+    repeat boot compiles nothing. Without a key the step is registered
+    anonymously: compile events still attribute to 'train_step', but the
+    program is private to the caller (the right default here, since the
+    ``tx``/``loss_fn`` closures have no stable identity of their own).
     """
     loss_args = dict(loss_args or {})
     model_args = dict(model_args or {})
@@ -276,12 +284,15 @@ def make_train_step(model, loss_fn, tx, mesh=None, loss_args=None,
 
         n_lead = 1
 
-    # instrument_jit: a passthrough label wrapper so telemetry attributes
-    # this function's (re)compiles to 'train_step' in compile events
+    # register_step: the registry Program attributes this function's
+    # (re)compiles to 'train_step' in compile events, counts them
+    # per-program, and (stable key + AOT store on) owns the serialized
+    # executables
     if mesh is None:
-        return instrument_jit(
+        return register_step(
             "train_step",
-            jax.jit(public, donate_argnums=(0,) if donate else ()))
+            jax.jit(public, donate_argnums=(0,) if donate else ()),
+            key=key)
 
     repl = partition.replicated(mesh)
     data = partition.data_sharding(mesh)
@@ -294,18 +305,18 @@ def make_train_step(model, loss_fn, tx, mesh=None, loss_args=None,
                                   if gather else repl)
 
     in_shardings = (state_in,) + (None,) * (n_lead - 1) + (data,) * 4
-    return instrument_jit("train_step", _with_data_axis(
+    return register_step("train_step", _with_data_axis(
         mesh.devices.size,
         jax.jit(
             public,
             in_shardings=in_shardings,
             out_shardings=(state_in, aux_shardings),
             donate_argnums=(0,) if donate else (),
-        )))
+        )), key=key)
 
 
 def make_eval_step(model, mesh=None, model_args=None, wire=None,
-                   variables_sharding=None):
+                   variables_sharding=None, key=None):
     """Build the jitted inference step returning the final flow.
 
     ``wire`` decodes compact-dtype images on device (see
@@ -313,7 +324,9 @@ def make_eval_step(model, mesh=None, model_args=None, wire=None,
     pytree of ``NamedSharding``s, e.g. from
     ``partition.Partitioner.variables_sharding``) lets the eval step
     take model-sharded parameters directly — they gather to replicated
-    inside the step; None keeps them replicated.
+    inside the step; None keeps them replicated. ``key`` registers the
+    step under a stable ``compile.ProgramKey`` (dedupe + AOT), as in
+    ``make_train_step``.
     """
     model_args = dict(model_args or {})
 
@@ -331,13 +344,13 @@ def make_eval_step(model, mesh=None, model_args=None, wire=None,
         return result.final()
 
     if mesh is None:
-        return instrument_jit("eval_step", jax.jit(step))
+        return register_step("eval_step", jax.jit(step), key=key)
 
     repl = partition.replicated(mesh)
     data = partition.data_sharding(mesh)
     variables_in = (variables_sharding if variables_sharding is not None
                     else repl)
-    return instrument_jit("eval_step", _with_data_axis(
+    return register_step("eval_step", _with_data_axis(
         mesh.devices.size,
         jax.jit(step, in_shardings=(variables_in, data, data),
-                out_shardings=data)))
+                out_shardings=data)), key=key)

@@ -89,15 +89,20 @@ class NonFinitePolicy:
 
 
 def _device_prefetch(samples, put, depth=2, tele=None):
-    """Pipeline host batches onto the device ahead of consumption.
+    """Double-buffered host→device prefetch: pipeline batches onto the
+    device ahead of consumption.
 
     On a remote/tunneled backend the per-step host->device input
     transfer (tens of MB per batch) otherwise serializes with compute —
     measured as the dominant step cost on the axon tunnel. A background
-    thread loads and ``put``s up to ``depth`` batches ahead; the main
-    loop receives (host_batch, device_batch, meta) with transfers
-    already in flight. Loader exceptions re-raise at the consumption
-    point.
+    thread loads and ``put``s up to ``depth`` batches ahead (default 2:
+    batch N+1 transfers while step N executes); the main loop receives
+    (host_batch, device_batch, meta) with transfers already in flight.
+    Loader exceptions re-raise at the consumption point.
+
+    ``RMD_PREFETCH=0`` swaps in :func:`_sync_transfer` (identical batch
+    stream, transfer left on the critical path — the A/B baseline);
+    ``RMD_PREFETCH_DEPTH`` tunes the buffer count.
 
     ``tele`` gets two phase streams: ``device_put`` (the worker's
     transfer-initiation time, attributed up to ``depth`` batches ahead of
@@ -135,6 +140,29 @@ def _device_prefetch(samples, put, depth=2, tele=None):
             if dev is not None:
                 raise dev
             return
+        yield host, dev, meta
+
+
+def _sync_transfer(samples, put, tele=None):
+    """RMD_PREFETCH=0: the same (host, dev, meta) stream as
+    :func:`_device_prefetch` with the transfer kept synchronous on the
+    critical path — the bit-identical A/B baseline for the prefetch
+    overlap, and an escape hatch for backends whose background-thread
+    ``device_put`` misbehaves. The ``device_put`` phase then lands on
+    the consuming step's wall time instead of overlapping it."""
+    tele = tele if tele is not None else telemetry.get()
+    it = iter(samples)
+    while True:
+        t0 = time.perf_counter()
+        try:
+            item = next(it)
+        except StopIteration:
+            return
+        tele.add_phase("data_wait", time.perf_counter() - t0)
+        img1, img2, flow, valid, meta = item
+        host = (img1, img2, flow, valid)
+        with tele.span("device_put"):
+            dev = put(host)
         yield host, dev, meta
 
 
@@ -580,6 +608,11 @@ class TrainingContext:
             # skip/rollback compile the on-device skip guard into the
             # step; raise keeps the unguarded update (NaNs absorbing)
             nonfinite="skip" if self.nonfinite.policy != "raise" else None,
+            # stable program identity: registry dedupe across rebuilds
+            # (resume/rollback in-process) and AOT artifact addressing —
+            # a repeat boot of the same stage config starts stepping
+            # without a single compile when the program store is warm
+            key=self._train_step_key(stage, with_grads),
         )
 
         import os
@@ -640,6 +673,42 @@ class TrainingContext:
         self.inspector.on_stage(log, self, stage)
         telemetry.get().emit("stage_end", stage=stage.index, step=self.step)
 
+    def _train_step_key(self, stage, with_grads):
+        """Stable ``compile.ProgramKey`` for this stage's train step.
+
+        Everything baked into the traced program is part of the identity:
+        the full stage config (model/loss args, optimizer, gradient spec —
+        hashed, the repr is long), wire format, mesh layout, the
+        non-finite guard, accumulation, and the aux-gradients flag.
+        Returns None when the stage config has no exact serialization
+        (synthetic test sources): the step then registers anonymously —
+        compile-counted but never deduped or AOT'd.
+        """
+        import hashlib
+
+        from .. import compile as programs
+
+        try:
+            stage_cfg = repr(stage.get_config())
+        except Exception:  # noqa: BLE001 - unserializable test stubs
+            return None
+        mesh_key = None
+        if self.mesh is not None:
+            mesh_key = (tuple(self.mesh.shape.items()),
+                        tuple(d.id for d in self.mesh.devices.flat))
+        return programs.ProgramKey(
+            kind="train_step", model=self.model_id,
+            flags=programs.flag_items(
+                stage=stage.id,
+                config=hashlib.sha256(stage_cfg.encode()).hexdigest()[:16],
+                wire=None if self.wire is None else self.wire.describe(),
+                mesh=mesh_key,
+                nonfinite=("skip" if self.nonfinite.policy != "raise"
+                           else None),
+                accumulate=self.accumulate,
+                with_grads=with_grads,
+            ))
+
     def run_epoch(self, log, stage, epoch):
         self.current_epoch = epoch
         tele = telemetry.get()
@@ -686,8 +755,18 @@ class TrainingContext:
         else:
             put = _make_put(base_put, self.wire, tele)
 
-        for i, (host, dev, meta) in enumerate(
-                _device_prefetch(samples, put, tele=tele)):
+        # double-buffered prefetch (default): batch N+1's device_put runs
+        # on a background thread while step N executes, so the transfer
+        # never sits on the step critical path. RMD_PREFETCH=0 restores
+        # the synchronous put (bit-identical results, for A/B and as an
+        # escape hatch); RMD_PREFETCH_DEPTH tunes how far ahead.
+        if _os.environ.get("RMD_PREFETCH", "1") == "0":
+            batches = _sync_transfer(samples, put, tele=tele)
+        else:
+            depth = max(1, int(_os.environ.get("RMD_PREFETCH_DEPTH", "2")))
+            batches = _device_prefetch(samples, put, depth=depth, tele=tele)
+
+        for i, (host, dev, meta) in enumerate(batches):
             log_ = log.new(f"step {self.step}", sep=", ")
             self.log = log_
 

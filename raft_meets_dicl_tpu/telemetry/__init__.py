@@ -26,7 +26,9 @@ from .core import (
     deactivate,
     enabled,
     get,
+    install_listeners,
     instrument_jit,
+    jit_label,
     memory_snapshot,
     validate_event,
 )
@@ -35,5 +37,6 @@ __all__ = [
     "core", "report",
     "SCHEMA", "SCHEMA_VERSION", "NullTelemetry", "Telemetry",
     "activate", "create", "deactivate", "enabled", "get",
-    "instrument_jit", "memory_snapshot", "validate_event",
+    "install_listeners", "instrument_jit", "jit_label",
+    "memory_snapshot", "validate_event",
 ]

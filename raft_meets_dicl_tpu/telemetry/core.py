@@ -60,6 +60,17 @@ SCHEMA = {
     # (parallel.partition.Partitioner.report) — emitted once per stage
     # when the training state is placed on the mesh
     "sharding": {"mesh", "params_bytes_per_chip", "opt_bytes_per_chip"},
+    # compiled-program registry (PR 7): one event per AOT artifact
+    # interaction — event is save | hit | miss | fallback, with program
+    # kind/model/digest and bytes/seconds where applicable. A 'fallback'
+    # means an artifact existed but could not be used (corruption,
+    # version mismatch, incompatible inputs): the boot paid a cold JIT
+    # it expected to skip, which the report flags as an anomaly.
+    "aot": {"event"},
+    # boot configuration: the effective persistent compile-cache and AOT
+    # program directories (instead of silently defaulting), plus the
+    # prefetch knob — emitted once per CLI run
+    "boot": {"compile_cache"},
     # fault-tolerance trail (PR 5): graceful-stop request (SIGTERM/SIGINT),
     # --resume auto pickup, corrupt-checkpoint quarantine, decode-worker
     # respawn, per-sample decode failure absorbed by the loader
@@ -307,18 +318,30 @@ def create(path=None):
     return Telemetry(path) if enabled() else NullTelemetry()
 
 
+@contextlib.contextmanager
+def jit_label(label, program=None):
+    """Scope the compile-attribution label (and, optionally, the owning
+    registry Program whose per-program counters the monitoring listener
+    increments) around a jitted call."""
+    prev = getattr(_jit_label, "value", None)
+    prev_prog = getattr(_jit_label, "program", None)
+    _jit_label.value = label
+    _jit_label.program = program
+    try:
+        yield
+    finally:
+        _jit_label.value = prev
+        _jit_label.program = prev_prog
+
+
 def instrument_jit(label, fn):
     """Label a jitted callable so compiles triggered inside it are
     attributed to ``label`` in compile events. Pure passthrough wrapper —
     donation/sharding semantics of ``fn`` are untouched."""
 
     def wrapped(*args, **kwargs):
-        prev = getattr(_jit_label, "value", None)
-        _jit_label.value = label
-        try:
+        with jit_label(label):
             return fn(*args, **kwargs)
-        finally:
-            _jit_label.value = prev
 
     wrapped.__wrapped__ = fn
     wrapped.telemetry_label = label
@@ -330,13 +353,16 @@ def instrument_jit(label, fn):
     return wrapped
 
 
-def _install_listeners():
+def install_listeners():
     """Register the process-wide jax.monitoring forwarders (idempotent).
 
     jax emits '/jax/core/compile/backend_compile_duration' per backend
     compile and '/jax/compilation_cache/cache_{hits,misses}' per
     persistent-cache lookup; both forward to whatever sink is active at
-    fire time, labeled by the innermost ``instrument_jit`` wrapper.
+    fire time, labeled by the innermost ``jit_label`` scope. Compile
+    durations also increment the scoped registry Program's counters —
+    those count even with the sink disabled, so eval/warmup compile
+    accounting never falls back to guessing (the pre-PR-7 overcount).
     """
     global _listeners_installed
     if _listeners_installed:
@@ -357,16 +383,24 @@ def _install_listeners():
                          label=getattr(_jit_label, "value", None))
 
     def on_duration(event, duration, **kwargs):
+        if event != "/jax/core/compile/backend_compile_duration":
+            return
+        program = getattr(_jit_label, "program", None)
+        if program is not None:
+            program.record_compile(float(duration))
         if not _active.enabled:
             return
-        if event == "/jax/core/compile/backend_compile_duration":
-            _active.emit("compile",
-                         label=getattr(_jit_label, "value", None) or "jit",
-                         seconds=round(float(duration), 6))
+        _active.emit("compile",
+                     label=getattr(_jit_label, "value", None) or "jit",
+                     seconds=round(float(duration), 6))
 
     monitoring.register_event_listener(on_event)
     monitoring.register_event_duration_secs_listener(on_duration)
     _listeners_installed = True
+
+
+# backwards-compatible internal name
+_install_listeners = install_listeners
 
 
 def memory_snapshot():

@@ -164,6 +164,16 @@ def find_anomalies(events, warmup_steps=DEFAULT_WARMUP_STEPS,
                 f"recompile after warmup: '{e['label']}' compiled for "
                 f"{e['seconds']:.2f} s after {steps_in_stage} steps in-stage")
 
+    # AOT fallbacks: an artifact existed but could not be used (corrupt,
+    # version-mismatched, incompatible inputs) — the boot paid a cold JIT
+    # it expected to skip
+    for e in events:
+        if e["kind"] == "aot" and e.get("event") == "fallback":
+            flags.append(
+                f"AOT fallback to cold JIT: "
+                f"{e.get('program', '?')}[{e.get('model', '?')}]"
+                + (f" ({e['reason']})" if "reason" in e else ""))
+
     for e in events:
         if e["kind"] == "nonfinite":
             action = e.get("action", "raise")
@@ -198,6 +208,35 @@ def fault_events(events):
     kinds = ("nonfinite", "preempt", "resume", "quarantine", "respawn",
              "bad_sample")
     return [e for e in events if e["kind"] in kinds]
+
+
+def aot_stats(events):
+    """Compiled-program / AOT summaries: per (program kind, model) the
+    artifact hits, misses, saves, fallbacks, bytes moved, and
+    serialize/deserialize milliseconds, plus the boot configuration
+    (effective compile-cache and program directories) when present."""
+    out = {"boot": None, "programs": {}}
+    for e in events:
+        if e["kind"] == "boot":
+            out["boot"] = {
+                "compile_cache": e.get("compile_cache"),
+                "aot_dir": e.get("aot_dir"),
+                "aot": e.get("aot"),
+                "prefetch": e.get("prefetch"),
+            }
+        elif e["kind"] == "aot":
+            key = (e.get("program", "?"), e.get("model", "?"))
+            agg = out["programs"].setdefault(key, {
+                "hit": 0, "miss": 0, "save": 0, "fallback": 0,
+                "bytes": 0, "seconds": 0.0, "reasons": []})
+            ev = e.get("event")
+            if ev in agg:
+                agg[ev] += 1
+            agg["bytes"] += e.get("bytes", 0)
+            agg["seconds"] += e.get("seconds", 0.0)
+            if ev == "fallback" and "reason" in e:
+                agg["reasons"].append(e["reason"])
+    return out
 
 
 def eval_stats(events):
@@ -351,6 +390,29 @@ def render(events, errors=(), warmup_steps=DEFAULT_WARMUP_STEPS,
                     f"  bucket {key:<12} {b['samples']:>6d} samples in "
                     f"{b['batches']} batches, {b.get('compiles', 0)} "
                     "compiles")
+
+    aot = aot_stats(events)
+    if aot["boot"] or aot["programs"]:
+        lines.append("")
+        lines.append("== compiled programs ==")
+        boot = aot["boot"]
+        if boot:
+            lines.append(
+                f"compile cache: {boot['compile_cache'] or 'disabled'}")
+            lines.append(
+                f"AOT programs:  {boot['aot_dir'] or 'disabled'}")
+            if boot.get("prefetch") is not None:
+                lines.append(
+                    "prefetch:      "
+                    + ("on (double-buffered device_put)"
+                       if boot["prefetch"] else "off (synchronous)"))
+        for (program, model), agg in sorted(aot["programs"].items()):
+            lines.append(
+                f"{program}[{model}]: {agg['hit']} AOT hits, "
+                f"{agg['miss']} misses, {agg['save']} saves, "
+                f"{agg['fallback']} fallbacks "
+                f"({agg['bytes'] / 2 ** 20:.1f} MiB, "
+                f"{agg['seconds'] * 1e3:.0f} ms serialize/load)")
 
     if compiles or caches:
         lines.append("")

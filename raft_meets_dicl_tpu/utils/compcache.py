@@ -5,6 +5,13 @@ persistent cache (verified working through the remote compile path)
 brings repeat compiles down to seconds. Enabled by default for the CLI
 and ``bench.py``; opt out with ``RMD_NO_COMPILE_CACHE=1``.
 
+The cache directory resolves ``--compile-cache`` (CLI) >
+``RMD_COMPILE_CACHE`` (or the legacy ``RMD_COMPILE_CACHE_DIR``) >
+the repo-local ``.jax_cache`` default; the effective directory is
+published in the run's ``boot`` telemetry event instead of being a
+silent default, and the AOT program store (``compile.aot``) keeps its
+``programs/`` directory next to it.
+
 The reference has no equivalent (torch eager needs none); this is the
 TPU-native answer to its "start training immediately" property.
 """
@@ -15,6 +22,15 @@ DEFAULT_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
     ".jax_cache")
 
+# the directory the last enable_persistent_cache() call actually
+# configured (None: disabled or never enabled) — for the boot event
+_effective = None
+
+
+def effective_dir():
+    """The configured cache directory, or None when the cache is off."""
+    return _effective
+
 
 def enable_persistent_cache(path: str | None = None) -> str | None:
     """Point jax at an on-disk compilation cache; returns the dir or None.
@@ -22,10 +38,15 @@ def enable_persistent_cache(path: str | None = None) -> str | None:
     Must run before the first backend use. Failures are non-fatal: the
     cache is an optimization, never a correctness dependency.
     """
+    global _effective
     if os.environ.get("RMD_NO_COMPILE_CACHE"):
+        _effective = None
         return None
 
-    path = path or os.environ.get("RMD_COMPILE_CACHE_DIR") or DEFAULT_DIR
+    path = (path
+            or os.environ.get("RMD_COMPILE_CACHE")
+            or os.environ.get("RMD_COMPILE_CACHE_DIR")
+            or DEFAULT_DIR)
     try:
         import jax
 
@@ -34,6 +55,7 @@ def enable_persistent_cache(path: str | None = None) -> str | None:
         # cache everything: even small entries add up across the zoo
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        _effective = path
         return path
     except Exception:  # noqa: BLE001 - never block startup on cache setup
         return None

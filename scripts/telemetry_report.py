@@ -4,10 +4,13 @@
 Reads the ``events.jsonl`` a training run writes by default (or any file
 produced by ``raft_meets_dicl_tpu.telemetry``), validates every record
 against the versioned schema, prints per-phase step timing stats
-(mean/p95/max/share), compile + persistent-cache counts, SPMD sharding
-placement (mesh shape, per-chip vs replicated param/opt bytes), memory
-watermarks, and flags anomalies: step-time spikes, recompiles after
-warmup, and non-finite-guard events.
+(mean/p95/max/share), compile + persistent-cache counts, the
+compiled-programs section (boot cache/AOT directories, per-program AOT
+hit/miss/save/fallback counts with bytes and serialize/load ms), SPMD
+sharding placement (mesh shape, per-chip vs replicated param/opt bytes),
+memory watermarks, and flags anomalies: step-time spikes, recompiles
+after warmup, non-finite-guard events, and boots that fell back from an
+AOT artifact to a cold JIT.
 
     python scripts/telemetry_report.py runs/<ts>/events.jsonl
     python scripts/telemetry_report.py runs/<ts>          # finds the file

@@ -1,0 +1,571 @@
+"""Compiled-program registry + AOT export + prefetch (PR 7).
+
+Covers: ProgramKey identity/stability, registry dedupe across the
+train-validation and eval-CLI paths, AOT save→reload roundtrips
+(bit-identical outputs, zero second-boot compiles), corrupted and
+version-mismatched artifacts falling back cleanly, per-program compile
+attribution (the warm-cache overcount bugfix), the configurable
+persistent-cache directory, the boot/aot telemetry schema + report
+section, and the RMD_PREFETCH on/off parity of the training loop.
+"""
+
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_meets_dicl_tpu import compile as programs
+from raft_meets_dicl_tpu import evaluation, parallel, telemetry
+import raft_meets_dicl_tpu.models as models
+
+
+@pytest.fixture
+def aot_store(tmp_path, monkeypatch):
+    """AOT program store enabled against a temp dir; clean registry."""
+    monkeypatch.delenv("RMD_AOT", raising=False)
+    monkeypatch.delenv("RMD_AOT_DIR", raising=False)
+    programs.reset()
+    d = tmp_path / "programs"
+    programs.enable_aot(str(d))
+    yield d
+    programs.disable_aot()
+    programs.reset()
+
+
+TINY_EVAL_MODEL = {
+    "name": "tiny-prog", "id": "tiny-prog",
+    "model": {
+        "type": "raft/baseline",
+        "parameters": {"corr-levels": 2, "corr-radius": 2,
+                       "corr-channels": 32, "context-channels": 16,
+                       "recurrent-channels": 16},
+        "arguments": {"iterations": 2},
+    },
+    "loss": {"type": "raft/sequence"},
+    "input": None,
+}
+
+
+# -- ProgramKey -----------------------------------------------------------
+
+
+def test_program_key_identity():
+    k1 = programs.ProgramKey("train_step", "m",
+                             programs.flag_items(a=1, wire="u8"))
+    k2 = programs.ProgramKey("train_step", "m",
+                             programs.flag_items(wire="u8", a=1))
+    assert k1 == k2  # flag order normalized
+    assert hash(k1) == hash(k2)
+    assert k1.canonical() == k2.canonical()
+
+    assert k1 != programs.ProgramKey("eval_step", "m", k1.flags)
+    assert k1 != programs.ProgramKey("train_step", "m2", k1.flags)
+    assert k1 != programs.ProgramKey(
+        "train_step", "m", programs.flag_items(a=2, wire="u8"))
+
+
+def test_program_key_stability():
+    stable = programs.ProgramKey("eval_step", "model-id",
+                                 programs.flag_items(wire=None))
+    assert stable.stable
+
+    by_object = programs.ProgramKey("eval_step", programs.unstable(object()))
+    assert not by_object.stable
+
+    # an unstable flag component also pins the key to the process
+    pinned = programs.ProgramKey(
+        "val_loss", "model-id",
+        programs.flag_items(loss=programs.unstable(object())))
+    assert not pinned.stable
+
+
+def test_shape_signature_over_pytrees():
+    sig = programs.shape_signature(
+        (({"a": jnp.zeros((2, 3)), "b": jnp.zeros((4,), jnp.int32)},),
+         1.5, True))
+    assert ((2, 3), "float32") in sig
+    assert ((4,), "int32") in sig
+    assert "float" in sig and "bool" in sig
+    # identical structure, different shape -> different signature
+    sig2 = programs.shape_signature(
+        (({"a": jnp.zeros((2, 4)), "b": jnp.zeros((4,), jnp.int32)},),
+         1.5, True))
+    assert sig != sig2
+
+
+# -- registry dedupe + compile attribution --------------------------------
+
+
+def test_registry_dedupe_and_anonymous():
+    programs.reset()
+    key = programs.ProgramKey("eval_step", "dedupe-model")
+    f1, f2 = jax.jit(lambda x: x + 1), jax.jit(lambda x: x + 1)
+    a = programs.register_step("eval_step", f1, key=key)
+    b = programs.register_step("eval_step", f2, key=key)
+    assert a is b  # same key: second build returns the first program
+
+    c = programs.register_step("eval_step", f1)
+    d = programs.register_step("eval_step", f1)
+    assert c is not d  # anonymous: never shared
+    programs.reset()
+
+
+def test_program_counts_compiles_without_telemetry_sink():
+    """Per-program compile counters come from the jax.monitoring
+    listener and work with the null sink — the basis of the warm-cache
+    accounting fix."""
+    programs.reset()
+    prog = programs.register_step("eval_step", jax.jit(lambda x: x * 2))
+    assert isinstance(telemetry.get(), telemetry.NullTelemetry)
+    assert prog.compiles == 0
+    prog(jnp.ones((3,)))
+    assert prog.compiles == 1
+    assert prog.compile_seconds > 0.0
+    prog(jnp.ones((3,)))
+    assert prog.compiles == 1  # jit cache hit: no new compile
+    prog(jnp.ones((4,)))
+    assert prog.compiles == 2  # new shape retraces
+    programs.reset()
+
+
+def test_eval_fn_dedupes_across_validation_and_cli_paths():
+    """The same (model, bucket, wire) triple builds ONE program whether
+    it is requested through the eval-CLI path or the training-validation
+    path — both name the model by its stable config id."""
+    programs.reset()
+    evaluation._EVAL_FN_CACHE.clear()
+    m_cli = models.load(TINY_EVAL_MODEL).model
+    m_val = models.load(TINY_EVAL_MODEL).model  # a distinct object
+    assert m_cli is not m_val
+
+    cli = evaluation.make_eval_fn(m_cli, {"iterations": 2},
+                                  model_id="tiny-prog")
+    evaluation._EVAL_FN_CACHE.clear()  # module cache out of the way
+    val = evaluation.make_eval_fn(m_val, {"iterations": 2},
+                                  model_id="tiny-prog")
+    assert cli is val
+
+    # and the validation step builder reuses exactly that program as its
+    # forward pass
+    from types import SimpleNamespace
+
+    from raft_meets_dicl_tpu.inspect.summary import StrategyValidation
+
+    sv = StrategyValidation(1, False, "", [], None)
+    ctx = SimpleNamespace(model=m_val, loss=models.load(TINY_EVAL_MODEL).loss,
+                          model_id="tiny-prog")
+    stage = SimpleNamespace(model_args={"iterations": 2}, loss_args={})
+    step = sv._val_step(ctx, stage)
+    assert step.programs[0] is cli
+    assert step.programs[1].key.kind == "val_loss"
+    programs.reset()
+
+
+def test_val_step_matches_fused_reference():
+    """The split validation step (shared forward program + loss program)
+    must produce the same numbers as the pre-PR-7 fused jit."""
+    from types import SimpleNamespace
+
+    from raft_meets_dicl_tpu.inspect.summary import StrategyValidation
+
+    programs.reset()
+    evaluation._EVAL_FN_CACHE.clear()
+    spec = models.load(TINY_EVAL_MODEL)
+    model, loss_fn = spec.model, spec.loss
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 32, 48, 3)),
+                           jnp.zeros((1, 32, 48, 3)), iterations=1)
+
+    rng = np.random.RandomState(7)
+    img1 = jnp.asarray(rng.rand(2, 32, 48, 3), jnp.float32)
+    img2 = jnp.asarray(rng.rand(2, 32, 48, 3), jnp.float32)
+    flow = jnp.asarray(rng.randn(2, 32, 48, 2), jnp.float32)
+    valid = jnp.ones((2, 32, 48), bool)
+
+    sv = StrategyValidation(1, False, "", [], None)
+    ctx = SimpleNamespace(model=model, loss=loss_fn, model_id="tiny-prog")
+    stage = SimpleNamespace(model_args={"iterations": 2}, loss_args={})
+    step = sv._val_step(ctx, stage)
+    assert sv._val_step(ctx, stage) is step  # memoized
+    est, loss = step(variables, img1, img2, flow, valid)
+
+    out = model.apply(variables, img1, img2, train=False, iterations=2)
+    result = model.get_adapter().wrap_result(out, (32, 48))
+    ref_est = result.final()
+    ref_loss = loss_fn(model, result.output(), flow, valid)
+
+    np.testing.assert_allclose(np.asarray(est), np.asarray(ref_est),
+                               atol=1e-5, rtol=1e-5)
+    assert float(loss) == pytest.approx(float(ref_loss), rel=1e-5)
+    programs.reset()
+
+
+# -- AOT roundtrip --------------------------------------------------------
+
+
+def _toy_step_fn():
+    def fn(state, x):
+        return {"w": state["w"] + x.sum()}, {"y": x * state["w"]}
+
+    return jax.jit(fn)
+
+
+def test_aot_roundtrip_bit_identical(aot_store):
+    key = programs.ProgramKey("train_step", "toy-roundtrip")
+    prog = programs.register_step("train_step", _toy_step_fn(), key=key)
+    state = {"w": jnp.asarray(2.0)}
+    x = jnp.arange(6, dtype=jnp.float32)
+
+    s1, aux1 = prog(state, x)
+    assert prog.aot_misses == 1 and prog.aot_saves == 1
+    assert len(list(aot_store.glob("*.rmdp"))) == 1
+
+    # "second boot": fresh registry, fresh jit closure, same key
+    programs.reset()
+    prog2 = programs.register_step("train_step", _toy_step_fn(), key=key)
+    s2, aux2 = prog2(state, x)
+    assert prog2.aot_hits == 1
+    assert prog2.compiles == 0  # the acceptance bar: zero compiles
+    assert np.array_equal(np.asarray(aux1["y"]), np.asarray(aux2["y"]))
+    assert np.array_equal(np.asarray(s1["w"]), np.asarray(s2["w"]))
+
+
+def test_aot_second_boot_emits_no_compile_events(aot_store):
+    """With artifacts present, a registered program records 0 compile
+    events in the telemetry sink on the next boot."""
+    key = programs.ProgramKey("train_step", "toy-events")
+    prog = programs.register_step("train_step", _toy_step_fn(), key=key)
+    prog({"w": jnp.asarray(1.0)}, jnp.ones((4,)))
+    assert prog.aot_saves == 1
+
+    programs.reset()
+    sink = telemetry.activate(telemetry.Telemetry())
+    try:
+        prog2 = programs.register_step("train_step", _toy_step_fn(),
+                                       key=key)
+        prog2({"w": jnp.asarray(1.0)}, jnp.ones((4,)))
+        compiles = [e for e in sink.events
+                    if e["kind"] == "compile"
+                    and e["label"] == "train_step"]
+        assert compiles == []
+        aot_events = [e for e in sink.events if e["kind"] == "aot"]
+        assert [e["event"] for e in aot_events] == ["hit"]
+        assert aot_events[0]["program"] == "train_step"
+    finally:
+        telemetry.deactivate()
+
+
+def test_aot_artifact_per_shape_signature(aot_store):
+    key = programs.ProgramKey("eval_step", "toy-shapes")
+    prog = programs.register_step("eval_step", jax.jit(lambda x: x + 1),
+                                  key=key)
+    prog(jnp.ones((2, 3)))
+    prog(jnp.ones((4, 5)))
+    assert prog.aot_saves == 2
+    assert len(list(aot_store.glob("*.rmdp"))) == 2
+
+
+def test_aot_corrupt_artifact_falls_back(aot_store):
+    key = programs.ProgramKey("train_step", "toy-corrupt")
+    prog = programs.register_step("train_step", _toy_step_fn(), key=key)
+    state, x = {"w": jnp.asarray(3.0)}, jnp.ones((5,))
+    _, aux_ref = prog(state, x)
+
+    artifact = next(aot_store.glob("*.rmdp"))
+    blob = bytearray(artifact.read_bytes())
+    blob[len(blob) // 2] ^= 0xFF  # flip a payload byte
+    artifact.write_bytes(bytes(blob))
+
+    programs.reset()
+    sink = telemetry.activate(telemetry.Telemetry())
+    try:
+        prog2 = programs.register_step("train_step", _toy_step_fn(),
+                                       key=key)
+        _, aux2 = prog2(state, x)  # must not raise
+        assert np.array_equal(np.asarray(aux_ref["y"]),
+                              np.asarray(aux2["y"]))
+        assert prog2.aot_hits == 0
+        assert prog2.aot_fallbacks >= 1
+        events = [e["event"] for e in sink.events if e["kind"] == "aot"]
+        assert "fallback" in events
+    finally:
+        telemetry.deactivate()
+
+    # truncation is also absorbed
+    artifact = next(aot_store.glob("*.rmdp"))
+    artifact.write_bytes(artifact.read_bytes()[:64])
+    programs.reset()
+    prog3 = programs.register_step("train_step", _toy_step_fn(), key=key)
+    _, aux3 = prog3(state, x)
+    assert np.array_equal(np.asarray(aux_ref["y"]), np.asarray(aux3["y"]))
+    assert prog3.aot_hits == 0
+
+
+def test_aot_version_mismatch_falls_back(aot_store):
+    key = programs.ProgramKey("train_step", "toy-version")
+    prog = programs.register_step("train_step", _toy_step_fn(), key=key)
+    state, x = {"w": jnp.asarray(1.0)}, jnp.ones((3,))
+    _, aux_ref = prog(state, x)
+
+    artifact = next(aot_store.glob("*.rmdp"))
+    record = pickle.loads(artifact.read_bytes())
+    record["fingerprint"] = "jax=0.0.0 stale"
+    artifact.write_bytes(pickle.dumps(record))
+
+    programs.reset()
+    prog2 = programs.register_step("train_step", _toy_step_fn(), key=key)
+    _, aux2 = prog2(state, x)
+    assert np.array_equal(np.asarray(aux_ref["y"]), np.asarray(aux2["y"]))
+    assert prog2.aot_hits == 0 and prog2.aot_fallbacks >= 1
+    # the cold compile re-saved a loadable artifact for the next boot
+    assert prog2.aot_saves == 1
+
+
+def test_aot_train_step_roundtrip_through_builder(aot_store):
+    """End-to-end through parallel.make_train_step: a keyed tiny train
+    step saves its executable; a fresh build reloads it with zero
+    compiles and bit-identical parameter updates."""
+    import optax
+
+    spec = models.load(TINY_EVAL_MODEL)
+    model, loss = spec.model, spec.loss
+    variables = jax.tree.map(np.asarray, model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 32, 48, 3)),
+        jnp.zeros((1, 32, 48, 3)), iterations=1))
+    tx = optax.adam(1e-3)
+
+    rng = np.random.RandomState(0)
+    batch = tuple(jnp.asarray(v) for v in (
+        rng.rand(2, 32, 48, 3).astype(np.float32),
+        rng.rand(2, 32, 48, 3).astype(np.float32),
+        rng.randn(2, 32, 48, 2).astype(np.float32),
+        np.ones((2, 32, 48), bool)))
+    key = programs.ProgramKey(
+        "train_step", "tiny-prog",
+        programs.flag_items(shape=(2, 32, 48), iterations=2))
+
+    def build_and_step():
+        state = parallel.TrainState.create(
+            jax.tree.map(jnp.asarray, variables), tx)
+        step = parallel.make_train_step(model, loss, tx,
+                                        model_args={"iterations": 2},
+                                        key=key)
+        new_state, aux = step(state, *batch)
+        return step, new_state, float(aux["loss"])
+
+    step1, state1, loss1 = build_and_step()
+    assert step1.aot_saves == 1
+
+    programs.reset()
+    step2, state2, loss2 = build_and_step()
+    assert step2.aot_hits == 1 and step2.compiles == 0
+    assert loss1 == loss2
+    for a, b in zip(jax.tree.leaves(state1.params),
+                    jax.tree.leaves(state2.params)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+# -- warm-cache compile accounting (overcount bugfix) ---------------------
+
+
+def test_warmup_compiles_not_overcounted_when_warm(aot_store):
+    """Second warmup over the same shapes reports 0 compiles — with the
+    telemetry sink disabled, where the pre-PR-7 fallback guessed 1 per
+    shape."""
+    evaluation._EVAL_FN_CACHE.clear()
+    model = models.load(TINY_EVAL_MODEL).model
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 32, 48, 3)),
+                           jnp.zeros((1, 32, 48, 3)), iterations=1)
+    fn = evaluation.make_eval_fn(model, {"iterations": 2},
+                                 model_id="tiny-prog-warm")
+    assert isinstance(telemetry.get(), telemetry.NullTelemetry)
+
+    cold = evaluation.EvalRunStats(name="cold")
+    evaluation.warmup_eval_fn(fn, variables, [(32, 48), (24, 40)], 2,
+                              stats=cold)
+    assert cold.compiles == 2
+
+    warm = evaluation.EvalRunStats(name="warm")
+    evaluation.warmup_eval_fn(fn, variables, [(32, 48), (24, 40)], 2,
+                              stats=warm)
+    assert warm.compiles == 0
+    assert warm.phases.get("warmup", 0.0) > 0.0
+    programs.reset()
+
+
+# -- compcache satellite --------------------------------------------------
+
+
+def test_compile_cache_dir_configurable(tmp_path, monkeypatch):
+    from raft_meets_dicl_tpu.utils import compcache
+
+    orig_dir = jax.config.jax_compilation_cache_dir
+    orig_entry = jax.config.jax_persistent_cache_min_entry_size_bytes
+    orig_secs = jax.config.jax_persistent_cache_min_compile_time_secs
+    try:
+        monkeypatch.delenv("RMD_NO_COMPILE_CACHE", raising=False)
+        monkeypatch.setenv("RMD_COMPILE_CACHE", str(tmp_path / "env-cache"))
+        got = compcache.enable_persistent_cache()
+        assert got == str(tmp_path / "env-cache")
+        assert compcache.effective_dir() == got
+        assert os.path.isdir(got)
+
+        # an explicit path (the --compile-cache flag) wins over the env
+        got = compcache.enable_persistent_cache(str(tmp_path / "cli-cache"))
+        assert got == str(tmp_path / "cli-cache")
+        assert compcache.effective_dir() == got
+
+        # kill switch
+        monkeypatch.setenv("RMD_NO_COMPILE_CACHE", "1")
+        assert compcache.enable_persistent_cache() is None
+        assert compcache.effective_dir() is None
+    finally:
+        jax.config.update("jax_compilation_cache_dir", orig_dir)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                          orig_entry)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          orig_secs)
+        compcache._effective = None
+
+
+def test_aot_dir_defaults_next_to_compile_cache(tmp_path, monkeypatch):
+    from raft_meets_dicl_tpu.utils import compcache
+
+    monkeypatch.delenv("RMD_AOT", raising=False)
+    monkeypatch.delenv("RMD_AOT_DIR", raising=False)
+    monkeypatch.setattr(compcache, "_effective", str(tmp_path / "cc"))
+    try:
+        got = programs.enable_aot()
+        assert got == os.path.join(str(tmp_path / "cc"), "programs")
+        assert programs.aot_enabled()
+        # RMD_AOT=0 wins
+        monkeypatch.setenv("RMD_AOT", "0")
+        assert programs.enable_aot() is None
+        assert not programs.aot_enabled()
+    finally:
+        programs.disable_aot()
+
+
+# -- telemetry schema + report --------------------------------------------
+
+
+def test_boot_and_aot_event_schema():
+    def ev(kind, **f):
+        return {"v": telemetry.SCHEMA_VERSION, "t": 0.0, "kind": kind, **f}
+
+    telemetry.validate_event(ev("boot", compile_cache=None, aot_dir=None,
+                                aot=False, prefetch=True))
+    telemetry.validate_event(ev("aot", event="hit", program="train_step",
+                                model="m", bytes=10, seconds=0.1))
+    with pytest.raises(ValueError):
+        telemetry.validate_event(ev("aot"))  # event field required
+    with pytest.raises(ValueError):
+        telemetry.validate_event(ev("boot"))
+
+
+def test_report_compiled_programs_section_and_anomaly():
+    from raft_meets_dicl_tpu.telemetry import report
+
+    def ev(kind, **f):
+        return {"v": telemetry.SCHEMA_VERSION, "t": 0.0, "kind": kind, **f}
+
+    events = [
+        ev("boot", compile_cache="/tmp/cc", aot_dir="/tmp/cc/programs",
+           aot=True, prefetch=True),
+        ev("aot", event="save", program="train_step", model="m",
+           bytes=2 ** 20, seconds=0.2),
+        ev("aot", event="hit", program="eval_step", model="m",
+           bytes=2 ** 19, seconds=0.05),
+        ev("aot", event="fallback", program="eval_step", model="m",
+           reason="corrupt: crc mismatch"),
+    ]
+    stats = report.aot_stats(events)
+    assert stats["boot"]["compile_cache"] == "/tmp/cc"
+    assert stats["programs"][("train_step", "m")]["save"] == 1
+    assert stats["programs"][("eval_step", "m")]["hit"] == 1
+    assert stats["programs"][("eval_step", "m")]["fallback"] == 1
+
+    text = report.render(events)
+    assert "compiled programs" in text
+    assert "/tmp/cc/programs" in text
+    assert "1 AOT hits" in text
+
+    flags = report.find_anomalies(events)
+    assert any("AOT fallback to cold JIT" in f for f in flags)
+    # a clean boot (no fallback) raises no AOT flag
+    clean = [e for e in events if e.get("event") != "fallback"]
+    assert not any("AOT" in f for f in report.find_anomalies(clean))
+
+
+# -- prefetch -------------------------------------------------------------
+
+
+def _run_tiny_training(tmp_path, monkeypatch, prefetch):
+    from test_strategy import _make_context, _make_stage
+
+    monkeypatch.setenv("RMD_PREFETCH", "1" if prefetch else "0")
+    np.random.seed(1234)  # init seed + epoch order identical across runs
+    ctx, _ = _make_context(tmp_path, [_make_stage(epochs=1)])
+    ctx.run()
+    assert ctx.step == 2
+    return jax.tree.map(np.asarray, ctx.variables)
+
+
+def test_prefetch_on_off_bit_identical(tmp_path, monkeypatch):
+    """RMD_PREFETCH only moves the device_put off the critical path —
+    training results are bit-identical with it on or off, and telemetry
+    records the device_put phase either way."""
+    sink_on = telemetry.activate(telemetry.Telemetry())
+    try:
+        v_on = _run_tiny_training(tmp_path / "on", monkeypatch, True)
+    finally:
+        telemetry.deactivate()
+
+    sink_off = telemetry.activate(telemetry.Telemetry())
+    try:
+        v_off = _run_tiny_training(tmp_path / "off", monkeypatch, False)
+    finally:
+        telemetry.deactivate()
+
+    leaves_on = jax.tree.leaves(v_on)
+    leaves_off = jax.tree.leaves(v_off)
+    assert len(leaves_on) == len(leaves_off)
+    for a, b in zip(leaves_on, leaves_off):
+        assert np.array_equal(a, b)
+
+    for sink in (sink_on, sink_off):
+        steps = [e for e in sink.events if e["kind"] == "step"]
+        phases = set().union(*(e["phases"] for e in steps))
+        assert {"data_wait", "device_put", "dispatch"} <= phases
+
+
+def test_prefetch_depth_knob(monkeypatch):
+    """The prefetch generator respects depth and re-raises loader
+    errors at the consumption point."""
+    from raft_meets_dicl_tpu.strategy.training import (
+        _device_prefetch, _sync_transfer,
+    )
+
+    items = [(np.full((1,), i), np.full((1,), i), None, None, [i])
+             for i in range(4)]
+    got = list(_device_prefetch(iter(items), lambda b: ("dev",) + b,
+                                depth=1, tele=telemetry.get()))
+    assert [m for *_, m in got] == [[0], [1], [2], [3]]
+    assert all(dev[0] == "dev" for _, dev, _ in got)
+
+    got = list(_sync_transfer(iter(items), lambda b: ("dev",) + b,
+                              tele=telemetry.get()))
+    assert [m for *_, m in got] == [[0], [1], [2], [3]]
+
+    def boom():
+        yield items[0]
+        raise RuntimeError("loader died")
+
+    it = _device_prefetch(boom(), lambda b: b, tele=telemetry.get())
+    next(it)
+    with pytest.raises(RuntimeError, match="loader died"):
+        next(it)

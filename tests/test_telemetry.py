@@ -241,6 +241,15 @@ def test_smoke_train_emits_schema_valid_events(tmp_path, monkeypatch):
 
     monkeypatch.setenv("RMD_FINITE_CHECK_EVERY", "1")
 
+    # cold program registry: the compiled-program registry dedupes the
+    # train step by its stable (model, stage-config) key, so a previous
+    # test's identical context would hand this run an already-compiled
+    # program — and the compile-attribution assertion below needs to see
+    # the compile happen
+    from raft_meets_dicl_tpu import compile as programs
+
+    programs.reset()
+
     sink = telemetry.activate(telemetry.create(tmp_path / "events.jsonl"))
     try:
         ctx, mgr = _make_context(tmp_path, [_make_stage(epochs=1)])
