@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from raft_meets_dicl_tpu.utils import env
+
 BASELINE_PAIRS_PER_SEC_PER_CHIP = 400.0 / 32.0
 
 
@@ -134,7 +136,7 @@ def _bench_input():
     height = int(os.environ.get("BENCH_HEIGHT", "400"))
     width = int(os.environ.get("BENCH_WIDTH", "720"))
     n = int(os.environ.get("BENCH_INPUT_SAMPLES", "48"))
-    procs = int(os.environ.get("RMD_LOADER_PROCS", "0"))
+    procs = env.get_int("RMD_LOADER_PROCS")
 
     class Synth:
         """Raw [0, 1] pairs generated per access — a stand-in for the

@@ -1,0 +1,26 @@
+"""graftlint: TPU-hazard static analysis + HLO program auditing.
+
+Public surface:
+
+- ``lint.run(root)`` — the AST lint pass (host-sync, tracer-branch,
+  f32-literal, env-knob/env-docs rules) with suppression + baseline
+  handling; ``lint.render_text`` / ``Report.to_dict`` for output,
+  ``lint.emit_events`` to forward findings as ``lint`` telemetry.
+- ``hlo.audit_registry()`` — lower/compile-time audit of the registered
+  step programs: fingerprint stability, collective counts, f32 convs,
+  baked-in constants.
+
+``scripts/graftlint.py`` is the CLI; the ``lint``-marked tests run both
+passes in tier-1.
+
+The lint half never *uses* jax (no tracing, no device access — pure
+``ast`` over source text), so it runs anywhere the package imports,
+with no accelerator attached; only ``hlo`` lowers and compiles
+programs.
+"""
+
+from . import astutil, lint
+from .lint import Baseline, Finding, Module, Report, Rule, run
+
+__all__ = ["astutil", "lint", "Baseline", "Finding", "Module", "Report",
+           "Rule", "run"]

@@ -1,0 +1,260 @@
+"""Shared AST machinery for the graftlint rules.
+
+The TPU-hazard rules all need the same three questions answered about a
+module, so the plumbing lives here instead of in each rule:
+
+1. *Which functions exist?* — a qualified-name table over the module's
+   (possibly nested) function definitions (``function_table``).
+2. *Which of them are jit roots?* — functions decorated with or passed
+   to ``jax.jit``-family transforms, and functions registered as step
+   programs through ``compile.register_step`` (``jit_roots``).
+3. *What can a root reach?* — an intra-module call graph over plain-name
+   calls **and** plain-name call arguments (functions handed to
+   ``lax.scan``/``vmap``/``checkpoint`` are invoked by the callee, so a
+   name passed into any call is treated as potentially called), walked
+   breadth-first (``reachable``).
+
+Resolution is lexical: a name used inside ``make_train_step.step``
+resolves against ``make_train_step.step.<name>``, then
+``make_train_step.<name>``, then ``<name>`` — mirroring Python's scoping
+closely enough for the hazard rules (no imports are chased; cross-module
+reachability is out of scope by design, the rules run per module).
+"""
+
+import ast
+from dataclasses import dataclass, field
+
+# decorator / call names that make a function a jit root
+JIT_NAMES = {"jit", "pjit", "pmap"}
+# functions whose function-valued argument becomes a registered step
+REGISTER_NAMES = {"register_step"}
+
+
+def dotted_name(node):
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _tail(dotted):
+    return dotted.rsplit(".", 1)[-1] if dotted else None
+
+
+@dataclass
+class FuncInfo:
+    """One function definition with its lexical position."""
+    qualname: str
+    node: ast.AST
+    scope: tuple  # enclosing function qualnames, outermost first
+    params: tuple = field(default_factory=tuple)
+
+
+class _Collector(ast.NodeVisitor):
+    def __init__(self):
+        self.table = {}
+        self._stack = []  # qualname components (classes and functions)
+        self._fn_stack = []  # enclosing *function* qualnames
+
+    def _visit_fn(self, node):
+        qual = ".".join(self._stack + [node.name])
+        a = node.args
+        params = tuple(p.arg for p in
+                       a.posonlyargs + a.args + a.kwonlyargs)
+        if a.vararg:
+            params += (a.vararg.arg,)
+        if a.kwarg:
+            params += (a.kwarg.arg,)
+        self.table[qual] = FuncInfo(qual, node, tuple(self._fn_stack),
+                                    params)
+        self._stack.append(node.name)
+        self._fn_stack.append(qual)
+        self.generic_visit(node)
+        self._fn_stack.pop()
+        self._stack.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def visit_ClassDef(self, node):
+        self._stack.append(node.name)
+        self.generic_visit(node)
+        self._stack.pop()
+
+
+def function_table(tree):
+    """qualname -> :class:`FuncInfo` for every function in the module."""
+    c = _Collector()
+    c.visit(tree)
+    return c.table
+
+
+def resolve(name, scope, table):
+    """Resolve a bare name against the lexical scope chain; returns the
+    qualname of a known function or None."""
+    for i in range(len(scope), -1, -1):
+        cand = scope[i - 1] + "." + name if i else name
+        if cand in table:
+            return cand
+    return None
+
+
+def _is_jit_expr(node):
+    """Whether an expression is a jit-family transform reference or a
+    ``partial(jax.jit, ...)``-style wrapper of one."""
+    tail = _tail(dotted_name(node))
+    if tail in JIT_NAMES:
+        return True
+    if isinstance(node, ast.Call):
+        if _tail(dotted_name(node.func)) == "partial" and node.args:
+            return _is_jit_expr(node.args[0])
+        # jax.jit(f, static_argnums=...) used as a decorator factory
+        return _is_jit_expr(node.func)
+    return False
+
+
+def jit_roots(tree, table):
+    """Qualnames of functions that enter jit: decorated with a jit-family
+    transform, passed (as a plain name) to a jit-family call, or passed
+    to ``register_step``."""
+    roots = set()
+    for qual, info in table.items():
+        for dec in getattr(info.node, "decorator_list", ()):
+            if _is_jit_expr(dec):
+                roots.add(qual)
+
+    class _Calls(ast.NodeVisitor):
+        def __init__(self):
+            self._fn_stack = []
+
+        def _visit_fn(self, node):
+            qual = (self._fn_stack[-1] + "." if self._fn_stack else "") \
+                + node.name
+            self._fn_stack.append(qual)
+            self.generic_visit(node)
+            self._fn_stack.pop()
+
+        visit_FunctionDef = _visit_fn
+        visit_AsyncFunctionDef = _visit_fn
+
+        def visit_Call(self, node):
+            scope = tuple(self._fn_stack)
+            tail = _tail(dotted_name(node.func))
+            if tail in JIT_NAMES:
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        hit = resolve(arg.id, scope, table)
+                        if hit:
+                            roots.add(hit)
+            if tail in REGISTER_NAMES:
+                cands = list(node.args[1:2]) + [
+                    kw.value for kw in node.keywords if kw.arg == "fn"]
+                for arg in cands:
+                    if isinstance(arg, ast.Name):
+                        hit = resolve(arg.id, scope, table)
+                        if hit:
+                            roots.add(hit)
+            self.generic_visit(node)
+
+    _Calls().visit(tree)
+    return roots
+
+
+def call_graph(table):
+    """qualname -> set(qualname): plain-name calls plus plain-name call
+    arguments, resolved lexically. Nested function bodies belong to the
+    nested function, not the enclosing one."""
+    graph = {qual: set() for qual in table}
+    for qual, info in table.items():
+        scope = info.scope + (qual,)
+        own_nested = {q for q, i in table.items() if qual in i.scope}
+
+        for node in ast.walk(info.node):
+            # skip statements owned by a nested def: they get their own
+            # edges, and reaching them requires a call/pass-through edge
+            if node is not info.node and isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            if _owner(node, info, table, own_nested) != qual:
+                continue
+            if isinstance(node.func, ast.Name):
+                hit = resolve(node.func.id, scope, table)
+                if hit:
+                    graph[qual].add(hit)
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Name):
+                    hit = resolve(arg.id, scope, table)
+                    if hit:
+                        graph[qual].add(hit)
+    return graph
+
+
+def _owner(node, info, table, own_nested):
+    """Qualname of the innermost function whose body contains ``node``.
+
+    Cheap containment test via line spans: the innermost nested function
+    whose [lineno, end_lineno] range covers the node wins; falls back to
+    ``info.qualname``.
+    """
+    line = getattr(node, "lineno", None)
+    if line is None:
+        return info.qualname
+    best, best_span = info.qualname, None
+    for q in own_nested:
+        n = table[q].node
+        if n.lineno <= line <= (n.end_lineno or n.lineno):
+            span = (n.end_lineno or n.lineno) - n.lineno
+            if best_span is None or span < best_span:
+                best, best_span = q, span
+    return best
+
+
+def reachable(roots, graph):
+    """BFS closure of ``roots`` over the call graph."""
+    seen = set(roots)
+    frontier = list(roots)
+    while frontier:
+        cur = frontier.pop()
+        for nxt in graph.get(cur, ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                frontier.append(nxt)
+    return seen
+
+
+def jit_reachable(tree, table=None):
+    """Qualnames of functions reachable from any jit root in ``tree``."""
+    table = table if table is not None else function_table(tree)
+    return reachable(jit_roots(tree, table), call_graph(table))
+
+
+def body_nodes(info, table):
+    """AST nodes owned directly by ``info``'s body (nested defs', class
+    bodies' nodes excluded — they belong to their own functions)."""
+    nested = [table[q].node for q in table
+              if info.qualname in table[q].scope]
+
+    def owned(node):
+        line = getattr(node, "lineno", None)
+        if line is None:
+            return True
+        for n in nested:
+            if n is not info.node and \
+                    n.lineno <= line <= (n.end_lineno or n.lineno):
+                return False
+        return True
+
+    for node in ast.walk(info.node):
+        if node is info.node:
+            continue
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if owned(node):
+            yield node

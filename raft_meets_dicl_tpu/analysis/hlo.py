@@ -1,0 +1,301 @@
+"""Trace-time program auditor over the PR-7 compiled-program registry.
+
+Static AST lint (``analysis.lint``) sees the source; this module sees
+what XLA will actually run. For a registered :class:`compile.Program` it
+lowers the jitted function and audits the result:
+
+- **fingerprint stability** — the program is lowered *twice* and the
+  canonicalized StableHLO (location metadata stripped) must hash
+  identically. Nondeterministic lowering (iteration over an unordered
+  container, a closure capturing fresh objects) makes every boot a
+  persistent-cache miss and every AOT artifact unreachable — precisely
+  the cold-start tax PR-7 exists to kill.
+- **collective counts** — taken from the *compiled* (post-GSPMD) HLO,
+  where sharding constraints have become all-gather/all-reduce/
+  reduce-scatter ops. This guards the PR-6 ZeRO contract: a sharded
+  train step must contain its gather/reduce pair, and any multi-device
+  step with zero cross-device ops means the gradient sync silently
+  vanished.
+- **f32 convolutions under a bf16 policy** — a mixed-precision model
+  whose lowered graph still convolves in f32 lost its policy somewhere
+  between Flax and XLA.
+- **baked-in constants > 1 MiB** — closure-captured weights serialized
+  into the program body: HBM paid per executable, AOT artifacts bloated,
+  and the persistent cache keyed on tensor *values*.
+
+The compile needed for the collective audit routes through jax's
+persistent compile cache like any other — on a warm cache the audit
+triggers zero fresh backend compiles (the acceptance bar for running it
+in tier-1).
+"""
+
+import hashlib
+import re
+
+from .lint import Finding
+
+# strip MLIR location metadata: `loc(...)` trailers and `#loc...` lines
+_LOC_RE = re.compile(r"\s*loc\([^)]*\)")
+_LOC_LINE_RE = re.compile(r"^#loc.*$", re.MULTILINE)
+
+_STABLEHLO_COLLECTIVES = ("all_reduce", "all_gather", "all_to_all",
+                          "reduce_scatter", "collective_permute")
+_HLO_COLLECTIVE_RE = re.compile(
+    r"\b(all-reduce|all-gather|all-to-all|reduce-scatter|"
+    r"collective-permute)(?:-start)?\b")
+
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "f16": 2, "bf16": 2,
+    "i64": 8, "ui64": 8, "i32": 4, "ui32": 4,
+    "i16": 2, "ui16": 2, "i8": 1, "ui8": 1, "i1": 1,
+    "c64": 8, "c128": 16,
+}
+
+_TENSOR_RE = re.compile(r"tensor<([0-9x]*)x?([a-z]+[0-9]*)>")
+_CONST_RE = re.compile(
+    r"stablehlo\.constant[^:\n]*:\s*tensor<([0-9x]+)x([a-z]+[0-9]*)>")
+
+LARGE_CONST_BYTES = 1 << 20  # 1 MiB
+
+
+def strip_locations(text):
+    """StableHLO text minus MLIR location metadata — the parts that may
+    legitimately differ between two lowerings of the same program."""
+    return _LOC_LINE_RE.sub("", _LOC_RE.sub("", text))
+
+
+def fingerprint(text):
+    """sha256 over the canonicalized module text."""
+    return hashlib.sha256(strip_locations(text).encode()).hexdigest()
+
+
+def _tensor_bytes(dims, dtype):
+    n = 1
+    for d in dims.split("x"):
+        if d:
+            n *= int(d)  # graftlint: disable=host-sync -- parses an HLO dims string, not a device value
+    return n * _DTYPE_BYTES.get(dtype, 4)
+
+
+def audit_stablehlo(text):
+    """Counts over a lowered StableHLO module's text."""
+    collectives = {}
+    for op in _STABLEHLO_COLLECTIVES:
+        n = text.count(f"stablehlo.{op} ") + text.count(f"stablehlo.{op}(")
+        if n:
+            collectives[op.replace("_", "-")] = n
+
+    f32_convs = 0
+    for line in text.splitlines():
+        if "stablehlo.convolution" not in line:
+            continue
+        _, _, result = line.rpartition("->")
+        m = _TENSOR_RE.search(result)
+        if m and m.group(2) == "f32":
+            f32_convs += 1
+
+    large = []
+    for m in _CONST_RE.finditer(text):
+        nbytes = _tensor_bytes(m.group(1), m.group(2))
+        if nbytes > LARGE_CONST_BYTES:
+            large.append({"type": f"tensor<{m.group(1)}x{m.group(2)}>",
+                          "bytes": nbytes})
+
+    return {"collectives": collectives, "f32_convolutions": f32_convs,
+            "large_constants": large}
+
+
+def audit_compiled(text):
+    """Collective counts over compiled (post-GSPMD) HLO text."""
+    counts = {}
+    for line in text.splitlines():
+        if " = " not in line:
+            continue
+        for m in _HLO_COLLECTIVE_RE.finditer(line.split(" = ", 1)[1]):
+            counts[m.group(1)] = counts.get(m.group(1), 0) + 1
+    return counts
+
+
+def audit_program(program, args, expect_bf16=False, n_devices=1,
+                  expect_gather=False, do_compile=True):
+    """Audit one registered program against concrete example args.
+
+    Returns ``(report, findings)``. The program is lowered twice for the
+    fingerprint-stability check; when ``do_compile``, the second lowering
+    is compiled (persistent-cache eligible) and its post-GSPMD HLO
+    provides the collective counts.
+    """
+    path = "analysis/hlo"  # findings anchor to the audit, not a file
+    key = program.key.canonical() if program.key else program.label
+
+    lowered_a = program.lower(*args)
+    text_a = lowered_a.as_text()
+    lowered_b = program.lower(*args)
+    text_b = lowered_b.as_text()
+
+    fp_a, fp_b = fingerprint(text_a), fingerprint(text_b)
+    stable = fp_a == fp_b
+
+    report = {
+        "key": key,
+        "label": program.label,
+        "fingerprint": fp_a,
+        "fingerprint_stable": stable,
+        **audit_stablehlo(text_a),
+    }
+
+    findings = []
+    if not stable:
+        findings.append(Finding(
+            rule="hlo-fingerprint", path=path, line=1,
+            message=f"{key}: two lowerings produced different StableHLO "
+                    f"({fp_a[:12]} vs {fp_b[:12]}) — nondeterministic "
+                    f"lowering defeats the persistent compile cache and "
+                    f"the AOT store"))
+    if expect_bf16 and report["f32_convolutions"]:
+        findings.append(Finding(
+            rule="hlo-f32-conv", path=path, line=1,
+            message=f"{key}: {report['f32_convolutions']} f32 "
+                    f"convolution(s) lowered under a bf16 policy"))
+    for c in report["large_constants"]:
+        findings.append(Finding(
+            rule="hlo-const-bake", path=path, line=1,
+            message=f"{key}: {c['bytes'] / 2**20:.1f} MiB constant "
+                    f"{c['type']} baked into the program (closure-"
+                    f"captured array? pass it as an argument)"))
+
+    if do_compile:
+        compiled = lowered_b.compile()
+        comp_collectives = audit_compiled(compiled.as_text())
+        report["compiled_collectives"] = comp_collectives
+        total = sum(comp_collectives.values())
+        if n_devices > 1 and total == 0:
+            findings.append(Finding(
+                rule="hlo-collectives", path=path, line=1,
+                message=f"{key}: compiled for {n_devices} devices with "
+                        f"ZERO collectives — cross-device sync (grad "
+                        f"all-reduce / ZeRO gather) vanished"))
+        if expect_gather and not (
+                comp_collectives.get("all-gather")
+                and (comp_collectives.get("reduce-scatter")
+                     or comp_collectives.get("all-reduce"))):
+            findings.append(Finding(
+                rule="hlo-collectives", path=path, line=1,
+                message=f"{key}: sharded-state step missing its ZeRO "
+                        f"gather/reduce pair (got {comp_collectives})"))
+
+    return report, findings
+
+
+def build_flagship_programs(n_devices=2, shape=(48, 64), mesh2d=False):
+    """Register the raft-baseline tiny-shape train + eval steps on a CPU
+    mesh and return ``[(program, args, audit_kwargs)]`` for auditing.
+
+    Mirrors ``__graft_entry__``'s dry-run construction (same model
+    config, tiny shapes) so the persistent compile cache and AOT store
+    warmed by earlier boots serve this audit without fresh compiles.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from .. import compile as programs, models, parallel
+
+    flagship = {
+        "name": "RAFT baseline", "id": "raft-baseline",
+        "model": {"type": "raft/baseline", "parameters": {}},
+        "loss": {"type": "raft/sequence"},
+        "input": {"padding": {"type": "modulo", "mode": "zeros",
+                              "size": [8, 8]}},
+    }
+    spec = models.load(flagship)
+    model, loss = spec.model, spec.loss
+    h, w = shape
+    b = n_devices
+    rng = np.random.RandomState(0)
+    img1 = jnp.asarray(rng.rand(b, h, w, 3).astype(np.float32))
+    img2 = jnp.asarray(rng.rand(b, h, w, 3).astype(np.float32))
+    flow = jnp.asarray(rng.randn(b, h, w, 2).astype(np.float32))
+    valid = jnp.asarray(np.ones((b, h, w), bool))
+
+    model_args = {"iterations": 2}
+    variables = model.init(jax.random.PRNGKey(0), img1[:1], img2[:1],
+                           **model_args)
+    tx = optax.chain(optax.clip_by_global_norm(1.0), optax.adamw(1e-4))
+
+    if mesh2d and n_devices >= 2 and n_devices % 2 == 0:
+        mesh = parallel.make_mesh((n_devices // 2, 2))
+        partitioner = parallel.Partitioner(mesh)
+    else:
+        mesh = parallel.data_mesh(n_devices)
+        partitioner = None
+
+    state = parallel.TrainState.create(variables, tx)
+    state_sharding = None
+    expect_gather = False
+    if partitioner is not None:
+        state = partitioner.shard_state(state)
+        state_sharding = partitioner.state_shardings(state)
+        expect_gather = parallel.partition.is_sharded(
+            state_sharding.params)
+    else:
+        state = parallel.replicate(state, mesh)
+
+    batch = parallel.shard_batch((img1, img2, flow, valid), mesh)
+
+    train_key = programs.ProgramKey(
+        kind="train_step", model="raft-baseline",
+        flags=programs.flag_items(shape=(b, h, w), audit=1,
+                                  mesh2d=bool(partitioner)))
+    make = parallel.make_train_step(
+        model, loss, tx, mesh=mesh, model_args=model_args,
+        state_sharding=state_sharding, donate=False, key=train_key)
+    del make  # audited via the registry entry
+
+    eval_key = programs.ProgramKey(
+        kind="eval_step", model="raft-baseline",
+        flags=programs.flag_items(shape=(b, h, w), audit=1))
+    parallel.make_eval_step(model, mesh=mesh, model_args=model_args,
+                            key=eval_key)
+
+    eval_variables = jax.device_put(
+        variables, parallel.partition.replicated(mesh))
+
+    reg = programs.registry()
+    out = []
+    train_prog = reg.get(train_key)
+    out.append((train_prog, (state, *batch),
+                {"n_devices": n_devices, "expect_gather": expect_gather}))
+    eval_prog = reg.get(eval_key)
+    out.append((eval_prog, (eval_variables, batch[0], batch[1]),
+                {"n_devices": n_devices}))
+    return out
+
+
+def audit_registry(entries=None, **build_kwargs):
+    """Audit every (program, args, kwargs) entry; defaults to the
+    flagship tiny-shape build. Returns ``(reports, findings)``."""
+    if entries is None:
+        entries = build_flagship_programs(**build_kwargs)
+    reports, findings = [], []
+    for program, args, kwargs in entries:
+        rep, fnd = audit_program(program, args, **kwargs)
+        reports.append(rep)
+        findings.extend(fnd)
+    return reports, findings
+
+
+def render_reports(reports):
+    """Human-readable audit section (CLI + telemetry_report reuse)."""
+    out = ["== hlo audit =="]
+    for r in reports:
+        coll = r.get("compiled_collectives", r.get("collectives", {}))
+        coll_s = (", ".join(f"{k}={v}" for k, v in sorted(coll.items()))
+                  or "none")
+        out.append(
+            f"{r['key']}: fingerprint {r['fingerprint'][:12]} "
+            f"({'stable' if r['fingerprint_stable'] else 'UNSTABLE'}), "
+            f"collectives: {coll_s}, f32 convs: {r['f32_convolutions']}, "
+            f"large consts: {len(r['large_constants'])}")
+    return "\n".join(out)

@@ -1,0 +1,150 @@
+"""Rule ``host-sync``: host-synchronizing calls on device values.
+
+Every one of these forces the host to wait for the device pipeline:
+
+- ``x.item()`` / ``x.block_until_ready()`` / ``jax.block_until_ready``
+- ``float(x)`` / ``int(x)`` on something that plausibly holds a device
+  array (see the argument heuristic below)
+- ``np.asarray(x)`` / ``np.array(x)`` on the same
+- ``jax.device_get(x)``
+
+Inside code reachable from a jit root the call is *always* a bug — it
+either fails under tracing or silently splits the program — so those are
+``error`` severity. Elsewhere the call may be a legitimate cold-path
+fetch (eval summaries, visualization, checkpoint metadata), but the cost
+model still wants them visible: ``warn`` severity, expected to carry a
+suppression or a baseline justification. PERF.md round 5 measured the
+damage: one per-step ``float(loss)`` serialized the async dispatch
+pipeline and cost 5.8 -> 1.2 s/step when removed.
+
+The ``float()``/``int()``/``asarray()`` argument heuristic keeps config
+parsing out of the findings: only bare names, subscripts (``aux["loss"]``)
+and calls rooted at jnp/jax-ish modules count; literals
+(``float("nan")``) and attribute chains (``float(args.lr)``) do not.
+Modules that never import jax are skipped entirely — pure-host code
+(data decoding, env parsing, visualization on numpy arrays) cannot
+device-sync no matter how many ``float()`` casts it performs.
+"""
+
+import ast
+
+from . import astutil
+from .lint import Finding, Rule
+
+RULE = "host-sync"
+
+# attribute-call syncs, flagged on any receiver
+SYNC_ATTRS = {"item", "block_until_ready"}
+# module-function syncs: tail of the dotted callee name
+SYNC_TAILS = {"device_get", "block_until_ready"}
+# numpy materializers whose argument heuristic applies
+NP_MATERIALIZERS = {"asarray", "array"}
+DEVICE_MODULES = {"jnp", "jax", "lax", "F", "functional", "np_or_jnp"}
+
+
+def _devicey(arg):
+    """Whether a call argument plausibly holds a device array."""
+    if isinstance(arg, (ast.Name, ast.Subscript)):
+        return True
+    if isinstance(arg, ast.Call):
+        dotted = astutil.dotted_name(arg.func)
+        if dotted:
+            return dotted.split(".")[0] in DEVICE_MODULES
+        return False
+    return False
+
+
+def _classify(node):
+    """(kind, detail) when ``node`` is a host-sync call, else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        dotted = astutil.dotted_name(fn) or ""
+        root = dotted.split(".")[0]
+        if fn.attr in SYNC_ATTRS:
+            return ("attr", f".{fn.attr}()")
+        if root in ("jax",) and fn.attr in SYNC_TAILS:
+            return ("jax", f"jax.{fn.attr}()")
+        if root in ("np", "numpy", "onp") and \
+                fn.attr in NP_MATERIALIZERS and node.args and \
+                _devicey(node.args[0]):
+            return ("np", f"{root}.{fn.attr}()")
+        return None
+    if isinstance(fn, ast.Name):
+        if fn.id in ("float", "int") and len(node.args) == 1 and \
+                _devicey(node.args[0]):
+            return ("cast", f"{fn.id}()")
+        if fn.id in SYNC_TAILS:
+            return ("jax", f"{fn.id}()")
+    return None
+
+
+def _owner_function(node, table):
+    """Qualname of the innermost function containing ``node`` (by line
+    span), or None at module level."""
+    line = getattr(node, "lineno", None)
+    if line is None:
+        return None
+    best, best_span = None, None
+    for qual, info in table.items():
+        n = info.node
+        if n.lineno <= line <= (n.end_lineno or n.lineno):
+            span = (n.end_lineno or n.lineno) - n.lineno
+            if best_span is None or span < best_span:
+                best, best_span = qual, span
+    return best
+
+
+def _imports_jax(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            if any(a.name == "jax" or a.name.startswith("jax.")
+                   for a in node.names):
+                return True
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod == "jax" or mod.startswith("jax."):
+                return True
+    return False
+
+
+def check(module):
+    if not _imports_jax(module.tree):
+        return []
+    table = astutil.function_table(module.tree)
+    hot = astutil.jit_reachable(module.tree, table)
+
+    findings, seen = [], set()
+    for node in ast.walk(module.tree):
+        hit = _classify(node)
+        if not hit:
+            continue
+        key = (node.lineno, node.col_offset)
+        if key in seen:
+            continue
+        seen.add(key)
+        owner = _owner_function(node, table)
+        detail = hit[1]
+        if owner in hot:
+            findings.append(Finding(
+                rule=RULE, path=module.rel, line=node.lineno,
+                severity="error",
+                message=f"{detail} inside jit-reachable '{owner}': host "
+                        f"sync under tracing (fails or splits the "
+                        f"program)"))
+        else:
+            findings.append(Finding(
+                rule=RULE, path=module.rel, line=node.lineno,
+                severity="warn",
+                message=f"{detail} forces a device sync; move it off "
+                        f"the hot path, batch the fetch, or justify it"))
+    return findings
+
+
+RULES = [Rule(
+    name=RULE,
+    doc="host-synchronizing calls (.item, float(), np.asarray, "
+        "device_get, block_until_ready); error when jit-reachable",
+    check=check,
+)]

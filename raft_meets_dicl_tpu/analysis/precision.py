@@ -1,0 +1,96 @@
+"""Rule ``f32-literal``: float32 leaking into mixed-precision models.
+
+PR-3 moved the DICL matching nets to bf16 under a ``dtype``-threaded
+policy; the win evaporates wherever a dtype-less ``jnp.zeros(...)`` (or
+an explicit ``dtype=jnp.float32``) materializes inside the module: XLA
+upcasts every consumer of the f32 operand, and a bf16 model silently
+computes chunks of its graph in f32 — costing the exact HBM/FLOP the
+policy was buying.
+
+Scope: methods of ``nn.Module`` subclasses that *declare a precision
+policy* — a class-level ``dtype`` or ``mixed_precision`` field — in
+files under ``models/``. Flagged:
+
+- dtype-less ``jnp.zeros/ones/full/empty/arange/linspace/eye/array``
+  calls (they default to f32): pass ``dtype=self.dtype`` or an explicit
+  dtype;
+- ``dtype=jnp.float32`` in the same constructors (legal, but must be
+  suppressed with a reason — e.g. FlowHead's documented f32 output
+  convention).
+
+``.astype(jnp.float32)`` is NOT flagged: explicit output-boundary casts
+are the documented convention for flow fields.
+"""
+
+import ast
+
+from . import astutil
+from .lint import Finding, Rule
+
+RULE = "f32-literal"
+
+CONSTRUCTORS = {"zeros", "ones", "full", "empty", "arange", "linspace",
+                "eye", "array", "identity"}
+POLICY_FIELDS = {"dtype", "mixed_precision"}
+
+
+def _policy_classes(tree):
+    """ClassDefs subclassing nn.Module that declare a precision field."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        bases = {astutil.dotted_name(b) or "" for b in node.bases}
+        if not any(b.rsplit(".", 1)[-1] == "Module" for b in bases):
+            continue
+        fields = set()
+        for stmt in node.body:
+            if isinstance(stmt, ast.AnnAssign) and \
+                    isinstance(stmt.target, ast.Name):
+                fields.add(stmt.target.id)
+            elif isinstance(stmt, ast.Assign):
+                fields.update(t.id for t in stmt.targets
+                              if isinstance(t, ast.Name))
+        if fields & POLICY_FIELDS:
+            out.append(node)
+    return out
+
+
+def check(module):
+    if "/models/" not in f"/{module.rel}":
+        return []
+    findings = []
+    for cls in _policy_classes(module.tree):
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = astutil.dotted_name(node.func) or ""
+            parts = dotted.split(".")
+            if len(parts) != 2 or parts[0] != "jnp" or \
+                    parts[1] not in CONSTRUCTORS:
+                continue
+            dtype_kw = next((kw for kw in node.keywords
+                             if kw.arg == "dtype"), None)
+            if dtype_kw is None:
+                findings.append(Finding(
+                    rule=RULE, path=module.rel, line=node.lineno,
+                    message=f"dtype-less {dotted}() in mixed-precision "
+                            f"module '{cls.name}' bakes float32 into "
+                            f"the graph; pass dtype= explicitly"))
+                continue
+            kw_name = astutil.dotted_name(dtype_kw.value) or ""
+            if kw_name in ("jnp.float32", "np.float32"):
+                findings.append(Finding(
+                    rule=RULE, path=module.rel, line=node.lineno,
+                    message=f"explicit {kw_name} in {dotted}() inside "
+                            f"mixed-precision module '{cls.name}'; "
+                            f"suppress with a reason if intentional"))
+    return findings
+
+
+RULES = [Rule(
+    name=RULE,
+    doc="f32 constants / dtype-less jnp constructors inside "
+        "mixed-precision model modules",
+    check=check,
+)]

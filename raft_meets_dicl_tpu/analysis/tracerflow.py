@@ -1,0 +1,150 @@
+"""Rule ``tracer-branch``: Python control flow on traced values.
+
+A Python ``if``/``while`` inside jit evaluates its condition eagerly at
+trace time; when the condition depends on a traced array the trace
+either raises ``TracerBoolConversionError`` or — worse, with
+``bool()``-coercible shapes — silently bakes one branch into the
+compiled program and *retraces on every boundary crossing*, defeating
+the PR-7 program registry. The fix is ``lax.cond``/``lax.while_loop``
+or ``jnp.where``.
+
+Taint model (per jit-reachable function, single forward pass):
+
+- the function's own parameters are traced;
+- names assigned from jnp/jax.lax/jax.nn calls, from tainted names, or
+  from expressions containing either, become traced;
+- ``x.shape`` / ``x.ndim`` / ``x.dtype`` / ``x.size`` and
+  ``len(x)`` / ``isinstance(x, ...)`` / ``x is None`` are trace-time
+  constants and launder the taint (static-shape dispatch like
+  ``if dim % block:`` stays legal — that's how the Pallas kernels and
+  the fs volume-split choose code paths).
+
+Closure variables from an enclosing builder (``accumulate``, ``wire``)
+are intentionally NOT tainted: step builders branch on static config at
+trace time by design.
+"""
+
+import ast
+
+from . import astutil
+from .lint import Finding, Rule
+
+RULE = "tracer-branch"
+
+TRACE_ROOTS = {"jnp", "lax", "jax"}
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval",
+                "weak_type"}
+SHIELD_FUNCS = {"isinstance", "len", "hasattr", "getattr", "callable",
+                "type", "repr", "str"}
+
+
+def _is_trace_call(node):
+    """Call whose result is (likely) a traced array: rooted at jnp/lax/
+    jax.* numeric namespaces."""
+    if not isinstance(node, ast.Call):
+        return False
+    dotted = astutil.dotted_name(node.func)
+    return bool(dotted) and dotted.split(".")[0] in TRACE_ROOTS
+
+
+def _expr_tainted(node, taint):
+    """Whether an expression's value carries taint."""
+    if isinstance(node, ast.Name):
+        return node.id in taint
+    if isinstance(node, ast.Attribute):
+        if node.attr in STATIC_ATTRS:
+            return False
+        return _expr_tainted(node.value, taint)
+    if _is_trace_call(node):
+        return True
+    if isinstance(node, ast.Call):
+        fname = astutil.dotted_name(node.func)
+        if fname and fname.rsplit(".", 1)[-1] in SHIELD_FUNCS:
+            return False
+        return any(_expr_tainted(a, taint) for a in node.args)
+    for child in ast.iter_child_nodes(node):
+        if _expr_tainted(child, taint):
+            return True
+    return False
+
+
+def _hot_names(node, taint):
+    """Tainted names used *as values* in a condition — occurrences under
+    a static attribute (``x.shape[0]``), a shield call (``len(x)``), or
+    an identity comparison (``x is None``) do not count."""
+    if isinstance(node, ast.Attribute):
+        if node.attr in STATIC_ATTRS:
+            return set()
+    if isinstance(node, ast.Call):
+        fname = astutil.dotted_name(node.func)
+        if fname and fname.rsplit(".", 1)[-1] in SHIELD_FUNCS:
+            return set()
+    if isinstance(node, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+        return set()
+    if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+        return {node.id} if node.id in taint else set()
+    out = set()
+    for child in ast.iter_child_nodes(node):
+        out |= _hot_names(child, taint)
+    return out
+
+
+def _taint_set(info, table):
+    """One-pass taint propagation over a function's own body."""
+    taint = set(info.params)
+    for node in astutil.body_nodes(info, table):
+        targets = ()
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AugAssign):
+            targets, value = (node.target,), node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = (node.target,), node.value
+        else:
+            continue
+        if _expr_tainted(value, taint):
+            for t in targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        taint.add(n.id)
+        else:
+            # reassignment from a clean value clears simple names
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    taint.discard(t.id)
+    return taint
+
+
+def check(module):
+    table = astutil.function_table(module.tree)
+    hot = astutil.jit_reachable(module.tree, table)
+
+    findings = []
+    for qual in sorted(hot):
+        info = table.get(qual)
+        if info is None:
+            continue
+        taint = _taint_set(info, table)
+        for node in astutil.body_nodes(info, table):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            names = _hot_names(node.test, taint)
+            if not names:
+                continue
+            kw = "while" if isinstance(node, ast.While) else "if"
+            findings.append(Finding(
+                rule=RULE, path=module.rel, line=node.lineno,
+                severity="error",
+                message=f"Python '{kw}' on traced value(s) "
+                        f"{sorted(names)} in jit-reachable '{qual}': "
+                        f"use lax.cond/lax.while_loop/jnp.where"))
+    return findings
+
+
+RULES = [Rule(
+    name=RULE,
+    doc="data-dependent Python if/while on traced values in "
+        "jit-reachable code",
+    check=check,
+)]

@@ -108,12 +108,10 @@ def evaluate(args):
     # canonical set and batch same-bucket samples — a KITTI-like sweep
     # then compiles at most n_buckets programs instead of one per
     # distinct padded shape, and batches stay full
-    import os
-
     from ..models.input import ShapeBuckets
 
     buckets_spec = (getattr(args, "buckets", None)
-                    or os.environ.get("RMD_EVAL_BUCKETS"))
+                    or utils.env.raw("RMD_EVAL_BUCKETS"))
     buckets = ShapeBuckets.from_config(buckets_spec)
     if buckets is not None:
         logging.info(f"shape buckets: {buckets.describe()}")

@@ -90,8 +90,6 @@ class Environment:
         }
 
     def apply(self):
-        import os
-
         import jax
 
         # compile-cache / AOT-store config (lowest precedence: the CLI
@@ -99,13 +97,13 @@ class Environment:
         # fill in what they left at the default). Runs before any
         # backend use, like every other env flag here.
         cache = self.compile.get("cache")
-        if (cache and not os.environ.get("RMD_COMPILE_CACHE")
-                and not os.environ.get("RMD_COMPILE_CACHE_DIR")):
+        if (cache and not utils.env.raw("RMD_COMPILE_CACHE")
+                and not utils.env.raw("RMD_COMPILE_CACHE_DIR")):
             from ..utils.compcache import enable_persistent_cache
 
             enable_persistent_cache(str(cache))
         aot = self.compile.get("aot")
-        if aot is not None and not os.environ.get("RMD_AOT_DIR"):
+        if aot is not None and not utils.env.raw("RMD_AOT_DIR"):
             from .. import compile as programs
 
             if aot is False:
@@ -271,8 +269,6 @@ def _train(args):
     # boot configuration event: the effective compile-cache and AOT
     # program directories (instead of silently defaulting) plus the
     # prefetch knob — the first thing a cold-start post-mortem needs
-    import os as _os
-
     from .. import compile as programs
     from ..utils import compcache
 
@@ -282,7 +278,7 @@ def _train(args):
         aot_dir=str(programs.programs_dir()) if programs.aot_enabled()
         else None,
         aot=programs.aot_enabled(),
-        prefetch=_os.environ.get("RMD_PREFETCH", "1") != "0",
+        prefetch=utils.env.get_bool("RMD_PREFETCH"),
     )
     if compcache.effective_dir():
         logging.info(
@@ -346,13 +342,11 @@ def _train(args):
     # parallelism, replicated params — the historical layout); 'D,M'
     # builds the 2-D (data × model) mesh whose 'model' axis shards
     # param/optimizer storage per parallel.partition's rules.
-    import os as _os
-
     import jax
 
     devices = select_devices(args.device, args.device_ids)
     mesh_cfg = (getattr(args, "mesh", None)
-                or _os.environ.get("RMD_MESH")
+                or utils.env.raw("RMD_MESH")
                 or env.parallel.get("mesh"))
     mesh_spec = parallel.parse_mesh_spec(mesh_cfg)
     if len(devices) > 1 or (mesh_spec is not None
@@ -381,7 +375,7 @@ def _train(args):
     # 'parallel' section; k microbatches per optimizer step inside the
     # jitted train step (k× effective batch, one microbatch's HBM)
     accumulate = int(getattr(args, "accumulate", None)
-                     or _os.environ.get("RMD_ACCUMULATE")
+                     or utils.env.raw("RMD_ACCUMULATE")
                      or env.parallel.get("accumulate", 1) or 1)
     if accumulate > 1:
         logging.info(f"gradient accumulation: {accumulate} microbatches "
@@ -406,12 +400,10 @@ def _train(args):
 
     # wire format: CLI flag > RMD_WIRE_FORMAT > env config. None keeps the
     # legacy host-normalized f32 batches.
-    import os
-
     from ..models.wire import WireFormat
 
     wire_cfg = (getattr(args, "wire_format", None)
-                or os.environ.get("RMD_WIRE_FORMAT")
+                or utils.env.raw("RMD_WIRE_FORMAT")
                 or env.wire)
     wire = WireFormat.from_config(wire_cfg)
     if wire is not None:
@@ -427,7 +419,7 @@ def _train(args):
     from ..models.input import ShapeBuckets
 
     eval_buckets = ShapeBuckets.from_config(
-        os.environ.get("RMD_EVAL_BUCKETS") or env.eval.get("buckets"))
+        utils.env.raw("RMD_EVAL_BUCKETS") or env.eval.get("buckets"))
     if eval_buckets is not None:
         logging.info(f"validation shape buckets: {eval_buckets.describe()}")
 
@@ -436,7 +428,7 @@ def _train(args):
     from ..strategy.training import NonFinitePolicy
 
     nf_cfg = (getattr(args, "nonfinite", None)
-              or os.environ.get("RMD_NONFINITE")
+              or utils.env.raw("RMD_NONFINITE")
               or env.nonfinite)
     nonfinite = NonFinitePolicy.from_config(nf_cfg)
     if nonfinite.policy != "raise":

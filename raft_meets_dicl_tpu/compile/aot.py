@@ -23,6 +23,8 @@ import pickle
 import time
 import zlib
 
+from ..utils import env
+
 _MAGIC = "RMDP1"
 # bump to invalidate every existing artifact when the program contract
 # changes (arg order, aux layout, ...)
@@ -43,11 +45,11 @@ def enable_aot(path=None):
     """Turn the AOT program store on (CLI/bench boots call this, mirroring
     ``compcache.enable_persistent_cache``); ``RMD_AOT=0`` wins. Returns
     the effective programs directory, or None when disabled."""
-    if os.environ.get("RMD_AOT", "1") == "0":
+    if not env.get_bool("RMD_AOT"):
         _state["on"] = False
         return None
     _state["on"] = True
-    _state["dir"] = path or os.environ.get("RMD_AOT_DIR") or None
+    _state["dir"] = path or env.raw("RMD_AOT_DIR") or None
     return programs_dir()
 
 

@@ -30,12 +30,14 @@ class EndPointError(Metric):
         return {"type": self.type, "key": self.key, "distances": self.distances}
 
     def compute(self, ctx, estimate, target, valid, loss):
-        vals = F.end_point_error(estimate, target, valid, self.distances)
+        # one batched device->host fetch for mean + every distance bucket
+        vals = F.fetch_scalars(
+            F.end_point_error(estimate, target, valid, self.distances))
 
         result = OrderedDict()
-        result[f"{self.key}mean"] = float(vals["mean"])
+        result[f"{self.key}mean"] = vals["mean"]
         for d in self.distances:
-            result[f"{self.key}{d}px"] = float(vals[f"{d}px"])
+            result[f"{self.key}{d}px"] = vals[f"{d}px"]
         return result
 
 

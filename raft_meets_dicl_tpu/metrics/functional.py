@@ -15,6 +15,7 @@ computes the same quantities on NCHW with ``dim=-3``
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def masked_mean(x, valid):
@@ -108,11 +109,11 @@ def tree_named_leaves(tree):
     return [(name(path), leaf) for path, leaf in flat]
 
 
-def _fetch(scalars):
+def fetch_scalars(scalars):
     """One device→host transfer for a whole dict of on-device scalars —
     per-leaf ``float()`` fetches would serialize the device pipeline."""
-    host = jax.device_get(scalars)
-    return {k: float(v) for k, v in host.items()}
+    host = jax.device_get(scalars)  # graftlint: disable=host-sync -- the sanctioned batched fetch point for metric scalars
+    return {k: float(v) for k, v in host.items()}  # graftlint: disable=host-sync -- values already on host (device_get above)
 
 
 def tree_norm(tree, ord=2):
@@ -121,17 +122,17 @@ def tree_norm(tree, ord=2):
     norms = {
         name: jnp.linalg.norm(jnp.ravel(leaf), ord=ord) for name, leaf in named
     }
-    norms = _fetch(norms)
-    norms["total"] = float(
-        jnp.linalg.norm(jnp.asarray(list(norms.values())), ord=ord)
-    )
+    norms = fetch_scalars(norms)
+    # total on host: the per-leaf norms were just fetched, so a jnp
+    # round-trip here would pay a second device sync for a tiny vector
+    norms["total"] = float(np.linalg.norm(list(norms.values()), ord=ord))
     return norms
 
 
 def tree_mean(tree):
     """Per-leaf (size, mean) + size-weighted 'total'."""
     named = tree_named_leaves(tree)
-    means = _fetch({name: jnp.mean(leaf) for name, leaf in named})
+    means = fetch_scalars({name: jnp.mean(leaf) for name, leaf in named})
     mean = {name: (int(leaf.size), means[name]) for name, leaf in named}
     total_size = sum(n for n, _ in mean.values()) or 1
     mean["total"] = (
@@ -144,8 +145,8 @@ def tree_mean(tree):
 def tree_minmax(tree):
     """Per-leaf (min, max) + overall 'total'."""
     named = tree_named_leaves(tree)
-    lo = _fetch({name: jnp.min(leaf) for name, leaf in named})
-    hi = _fetch({name: jnp.max(leaf) for name, leaf in named})
+    lo = fetch_scalars({name: jnp.min(leaf) for name, leaf in named})
+    hi = fetch_scalars({name: jnp.max(leaf) for name, leaf in named})
     mm = {name: (lo[name], hi[name]) for name, _ in named}
     mm["total"] = (
         min(l for l, _ in mm.values()),

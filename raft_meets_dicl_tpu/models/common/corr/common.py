@@ -13,7 +13,6 @@ to the fused Pallas kernel on TPU unless the ``RMD_DICL_FAST=0`` escape
 hatch forces the reference path.
 """
 
-import os
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -26,7 +25,9 @@ from ..blocks.dicl import DisplacementAwareProjection
 def dicl_fast_enabled():
     """DICL fast-path switch, read at trace time: ``RMD_DICL_FAST=0``
     restores the reference XLA sampler + per-level matching loops."""
-    return os.environ.get("RMD_DICL_FAST", "1") != "0"
+    from ....utils import env
+
+    return env.get_bool("RMD_DICL_FAST")
 
 
 def sample_window_fast(f2, coords, radius):

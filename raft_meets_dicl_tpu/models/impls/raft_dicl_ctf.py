@@ -269,7 +269,7 @@ class RaftPlusDiclCtfModule(nn.Module):
                 flows, hiddens, readouts, prevs = [], [], [], []
                 for _ in range(n_iter):
                     carry, (fl, hi, ro, pv) = step(
-                        carry, jnp.zeros((0,)),
+                        carry, jnp.zeros((0,), dtype=jnp.bfloat16),
                         f1[fine_idx], f2[fine_idx], x, coords0,
                     )
                     flows.append(fl)
@@ -293,7 +293,7 @@ class RaftPlusDiclCtfModule(nn.Module):
                 )(**shared)
 
                 (h_state, coords1), (flows, hiddens, readouts, prevs) = step(
-                    (h_state, coords1), jnp.zeros((n_iter, 0)),
+                    (h_state, coords1), jnp.zeros((n_iter, 0), dtype=jnp.bfloat16),
                     f1[fine_idx], f2[fine_idx], x, coords0,
                 )
 

@@ -397,7 +397,7 @@ class RaftPlusDiclMlModule(nn.Module):
             flows, hiddens, corr_flows = [], [], []
             for _ in range(iterations):
                 carry, (fl, hi, cf) = step(
-                    carry, jnp.zeros((0,)), fmap1, fmap2, x, coords0)
+                    carry, jnp.zeros((0,), dtype=jnp.bfloat16), fmap1, fmap2, x, coords0)
                 flows.append(fl)
                 hiddens.append(hi)
                 corr_flows.append(cf)
@@ -430,7 +430,7 @@ class RaftPlusDiclMlModule(nn.Module):
             )(**shared)
 
             (h, coords1), (flows, hiddens, corr_flows) = step(
-                (h, coords1), jnp.zeros((iterations, 0)),
+                (h, coords1), jnp.zeros((iterations, 0), dtype=jnp.bfloat16),
                 fmap1, fmap2, x, coords0,
             )
 

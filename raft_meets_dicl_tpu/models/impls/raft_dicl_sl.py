@@ -123,7 +123,7 @@ class RaftPlusDiclModule(nn.Module):
             flows, hiddens, readouts = [], [], []
             for _ in range(iterations):
                 carry, (fl, hi, ro, _pv) = step(
-                    carry, jnp.zeros((0,)), fmap1, fmap2, x, coords0)
+                    carry, jnp.zeros((0,), dtype=jnp.bfloat16), fmap1, fmap2, x, coords0)
                 flows.append(fl)
                 hiddens.append(hi)
                 readouts.append(ro)
@@ -143,7 +143,7 @@ class RaftPlusDiclModule(nn.Module):
             )(**shared)
 
             (h, coords1), (flows, hiddens, readouts, _prevs) = step(
-                (h, coords1), jnp.zeros((iterations, 0)),
+                (h, coords1), jnp.zeros((iterations, 0), dtype=jnp.bfloat16),
                 fmap1, fmap2, x, coords0,
             )
 

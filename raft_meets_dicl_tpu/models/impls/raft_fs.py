@@ -44,12 +44,11 @@ def volume_level_split(coarse_shape, corr_levels, itemsize, budget_gib=None):
     batch-sharded volume, so the estimate divides by the data-parallel
     degree published by the step builders (parallel.mesh).
     """
-    import os
-
     from ...parallel.mesh import data_axis_size
+    from ...utils import env
 
     if budget_gib is None:
-        budget_gib = float(os.environ.get("RMD_FS_VOLUME_GIB", "4.0"))
+        budget_gib = env.get_float("RMD_FS_VOLUME_GIB")
     budget = budget_gib * 2 ** 30
 
     b0, hc0, wc0 = coarse_shape

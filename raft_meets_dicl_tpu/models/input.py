@@ -9,7 +9,6 @@ GIL) rather than a torch DataLoader with worker processes.
 
 import concurrent.futures
 import copy
-import os
 import threading
 from dataclasses import replace
 
@@ -643,7 +642,7 @@ class Loader:
         self.shard = shard
         self.group_by_shape = bool(group_by_shape)
         if procs is None:
-            procs = int(os.environ.get("RMD_LOADER_PROCS", "0"))
+            procs = utils.env.get_int("RMD_LOADER_PROCS")
         self.procs = max(0, int(procs))
         if seed is None:
             seed = int(np.random.randint(0, 2**31 - 1))
@@ -656,11 +655,10 @@ class Loader:
         # the bad-sample budget; exceeding it aborts the epoch: at that
         # point the data (or its storage) is broken, not flaky.
         if retries is None:
-            retries = int(os.environ.get("RMD_LOADER_RETRIES", "2"))
+            retries = utils.env.get_int("RMD_LOADER_RETRIES")
         self.retries = max(0, int(retries))
         if bad_sample_budget is None:
-            bad_sample_budget = int(
-                os.environ.get("RMD_BAD_SAMPLE_BUDGET", "16"))
+            bad_sample_budget = utils.env.get_int("RMD_BAD_SAMPLE_BUDGET")
         self.bad_sample_budget = max(0, int(bad_sample_budget))
         self._bad_samples = 0
         self._bad_lock = threading.Lock()

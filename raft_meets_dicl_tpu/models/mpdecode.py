@@ -31,6 +31,7 @@ from multiprocessing import shared_memory
 import numpy as np
 
 from ..testing import faults
+from ..utils import env
 
 
 class PoolBroken(RuntimeError):
@@ -139,7 +140,7 @@ class DecodePool:
 
     def __init__(self, source, procs, start_method=None,
                  timeout=None, poll=None, max_respawns=None):
-        method = start_method or os.environ.get("RMD_LOADER_MP", "fork")
+        method = start_method or env.get_str("RMD_LOADER_MP")
         self._ctx = mp.get_context(method)
         self._source = source
         self._tasks = self._ctx.Queue()
@@ -151,18 +152,14 @@ class DecodePool:
         self._respawns = 0
         self._backoff = 0.0
 
-        def _env(name, default):
-            v = os.environ.get(name)
-            return float(v) if v else default
-
         # total wait per sample before the pool declares the pipeline
         # wedged; poll interval bounds dead-worker detection latency
-        self._timeout = timeout if timeout is not None else _env(
-            "RMD_LOADER_TIMEOUT", 300.0)
-        self._poll = poll if poll is not None else _env(
-            "RMD_LOADER_POLL", 5.0)
+        self._timeout = (timeout if timeout is not None
+                         else env.get_float("RMD_LOADER_TIMEOUT"))
+        self._poll = (poll if poll is not None
+                      else env.get_float("RMD_LOADER_POLL"))
         self._max_respawns = int(max_respawns if max_respawns is not None
-                                 else _env("RMD_LOADER_RESPAWNS", 3))
+                                 else env.get_int("RMD_LOADER_RESPAWNS"))
 
         self._workers = [self._spawn() for _ in range(max(1, int(procs)))]
 

@@ -712,9 +712,9 @@ def _wcp_fwd_tpu(f1, f2_levels, coords, radius, interpret=False,
 
 
 def _wcp_band_enabled():
-    import os
+    from ..utils import env
 
-    return os.environ.get("RMD_WCP_BAND", "1") != "0"
+    return env.get_bool("RMD_WCP_BAND")
 
 
 def _wcp_bwd_tpu(f1, f2_levels, coords, dout, radius, interpret=False,

@@ -38,6 +38,7 @@ one, bit for bit.
 """
 
 import re
+import warnings
 
 import jax
 import numpy as np
@@ -246,10 +247,55 @@ class Partitioner:
         ]
         return jax.tree.unflatten(treedef, shardings)
 
+    # -- rule-coverage audit -----------------------------------------------
+
+    def coverage(self, params):
+        """Static rule-coverage audit over a parameter tree.
+
+        Consults the raw rule list directly — bypassing the
+        ``model_size <= 1`` degeneration in :meth:`spec` — so a 1-chip
+        CI run still validates the rule set against a real param tree.
+
+        A *dead rule* is one with a non-trivial spec (it was written to
+        shard something) that matches zero param paths: a typo'd module
+        name silently replicates everything it meant to shard.
+        ``unmatched`` lists paths no rule claims at all (impossible with
+        the default catch-all, but a custom rule list can drop it).
+        """
+        paths = [_path_str(p)
+                 for p, _ in tree_flatten_with_path(params)[0]]
+        counts = [0] * len(self.rules)
+        unmatched = []
+        for name in paths:
+            for i, (rx, _spec) in enumerate(self.rules):
+                if rx.search(name):
+                    counts[i] += 1
+                    break
+            else:
+                unmatched.append(name)
+        dead = [rx.pattern
+                for (rx, spec), n in zip(self.rules, counts)
+                if n == 0 and tuple(spec)]
+        return {
+            "n_paths": len(paths),
+            "rule_matches": [(rx.pattern, n)
+                             for (rx, _), n in zip(self.rules, counts)],
+            "dead_rules": dead,
+            "unmatched": unmatched,
+        }
+
     # -- placement + accounting --------------------------------------------
 
     def shard_state(self, state):
         """Place a TrainState according to the rules (device_put)."""
+        cov = self.coverage(state.params)
+        if cov["dead_rules"] or cov["unmatched"]:
+            warnings.warn(
+                f"partition rules audit: dead rules {cov['dead_rules']}, "
+                f"unmatched paths {cov['unmatched'][:5]}"
+                f"{'...' if len(cov['unmatched']) > 5 else ''} "
+                f"(of {cov['n_paths']} param paths)",
+                stacklevel=2)
         return jax.device_put(state, self.state_shardings(state))
 
     def shard_variables(self, variables):
@@ -283,7 +329,10 @@ class Partitioner:
 
         p_tot, p_chip, p_sh, p_n = account(state.params)
         o_tot, o_chip, o_sh, o_n = account(state.opt_state)
+        cov = self.coverage(state.params)
         return {
+            "dead_rules": cov["dead_rules"],
+            "unmatched_paths": len(cov["unmatched"]),
             "mesh": {name: int(self.mesh.shape[name])
                      for name in self.mesh.axis_names},
             "params_bytes_replicated": p_tot,

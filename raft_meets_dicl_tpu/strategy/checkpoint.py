@@ -568,7 +568,7 @@ class CheckpointManager:
             },
         )
 
-        background = os.environ.get("RMD_ASYNC_CHECKPOINT", "1") != "0"
+        background = utils.env.get_bool("RMD_ASYNC_CHECKPOINT")
         tele = telemetry.get()
 
         def emit(blocking, bg):

@@ -615,8 +615,6 @@ class TrainingContext:
             key=self._train_step_key(stage, with_grads),
         )
 
-        import os
-
         self._accum = 0
         self._in_step = False
         self._pending_finite = None
@@ -628,7 +626,7 @@ class TrainingContext:
         # finite-check cadence (steps); 1 restores the check-every-step
         # behavior for debugging
         self._finite_every = max(
-            1, int(os.environ.get("RMD_FINITE_CHECK_EVERY", "10")))
+            1, utils.env.get_int("RMD_FINITE_CHECK_EVERY"))
 
         # device-sync sampling bookkeeping: device step time is measured
         # at the finite-fetch cadence (the fetch is already a pipeline
@@ -727,9 +725,7 @@ class TrainingContext:
 
         base_put = ((lambda b: shard_batch(b, self.mesh))
                     if self.mesh is not None else jax.device_put)
-        import os as _os
-
-        if _os.environ.get("RMD_PREFETCH_PUT", "1") == "0":
+        if not utils.env.get_bool("RMD_PREFETCH_PUT"):
             # host-only prefetch: overlap decode but let jit do the
             # implicit arg transfer (fallback for backends whose explicit
             # device_put path misbehaves)
@@ -738,7 +734,7 @@ class TrainingContext:
         if (self.wire is None
                 and getattr(getattr(self.model, "module", None),
                             "mixed_precision", False)
-                and _os.environ.get("RMD_WIRE_BF16", "1") != "0"):
+                and utils.env.get_bool("RMD_WIRE_BF16")):
             # legacy lightweight compression (pre-wire-format): the model
             # computes its encoders in bf16 anyway, so transferring the
             # host-normalized images as bf16 halves the dominant bytes
@@ -760,10 +756,10 @@ class TrainingContext:
         # never sits on the step critical path. RMD_PREFETCH=0 restores
         # the synchronous put (bit-identical results, for A/B and as an
         # escape hatch); RMD_PREFETCH_DEPTH tunes how far ahead.
-        if _os.environ.get("RMD_PREFETCH", "1") == "0":
+        if not utils.env.get_bool("RMD_PREFETCH"):
             batches = _sync_transfer(samples, put, tele=tele)
         else:
-            depth = max(1, int(_os.environ.get("RMD_PREFETCH_DEPTH", "2")))
+            depth = max(1, utils.env.get_int("RMD_PREFETCH_DEPTH"))
             batches = _device_prefetch(samples, put, depth=depth, tele=tele)
 
         for i, (host, dev, meta) in enumerate(batches):
@@ -783,11 +779,11 @@ class TrainingContext:
         # memory watermarks: RMD_DEBUG_MEM's ad-hoc print, promoted to a
         # structured per-epoch event (snapshot cost is one procfs read +
         # a live-array census — epoch-boundary cheap)
-        if tele.enabled or _os.environ.get("RMD_DEBUG_MEM"):
+        if tele.enabled or utils.env.get_bool("RMD_DEBUG_MEM"):
             snap = telemetry.memory_snapshot()
             tele.emit("memory", stage=stage.index, epoch=epoch,
                       step=self.step, **snap)
-            if _os.environ.get("RMD_DEBUG_MEM"):
+            if utils.env.get_bool("RMD_DEBUG_MEM"):
                 log.info(f"mem: rss {snap['host_rss_gib']:.2f} GiB, "
                          f"live jax arrays {snap['live_arrays']}")
 

@@ -74,6 +74,10 @@ SCHEMA = {
     # fault-tolerance trail (PR 5): graceful-stop request (SIGTERM/SIGINT),
     # --resume auto pickup, corrupt-checkpoint quarantine, decode-worker
     # respawn, per-sample decode failure absorbed by the loader
+    # graftlint static-analysis/HLO-audit findings (PR 8): one event per
+    # finding when the lint pass runs with a telemetry sink attached;
+    # status is open | baselined | suppressed, severity error | warn
+    "lint": {"rule", "path", "line", "status"},
     "preempt": {"signal", "step"},
     "resume": {"path", "step"},
     "quarantine": {"path"},
@@ -119,7 +123,9 @@ def validate_event(ev):
 
 def enabled():
     """The documented kill switch: RMD_TELEMETRY=0 disables everything."""
-    return os.environ.get("RMD_TELEMETRY", "1") != "0"
+    from ..utils import env
+
+    return env.get_bool("RMD_TELEMETRY")
 
 
 class NullTelemetry:

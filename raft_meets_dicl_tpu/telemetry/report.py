@@ -201,6 +201,28 @@ def find_anomalies(events, warmup_steps=DEFAULT_WARMUP_STEPS,
     return flags
 
 
+def lint_stats(events):
+    """Aggregate ``lint`` events (graftlint findings forwarded via
+    ``analysis.lint.emit_events``): per-rule counts split by status,
+    plus the open findings themselves (the ones that fail the gate)."""
+    per_rule = {}
+    open_findings = []
+    total = 0
+    for e in events:
+        if e["kind"] != "lint":
+            continue
+        total += 1
+        rule = e["rule"]
+        status = e.get("status", "open")
+        agg = per_rule.setdefault(rule, {"open": 0, "suppressed": 0,
+                                         "baselined": 0})
+        agg[status] = agg.get(status, 0) + 1
+        if status == "open":
+            open_findings.append(e)
+    return {"total": total, "per_rule": per_rule,
+            "open": open_findings}
+
+
 def fault_events(events):
     """The run's fault-tolerance trail, in order: non-finite skips and
     rollbacks, preemption stops, auto-resume pickups, checkpoint
@@ -465,6 +487,19 @@ def render(events, errors=(), warmup_steps=DEFAULT_WARMUP_STEPS,
                 lines.append(
                     f"  substituted bad sample {e['index']}"
                     + (f" ({e['error']})" if "error" in e else ""))
+
+    lint = lint_stats(events)
+    if lint["total"]:
+        lines.append("")
+        lines.append(f"== lint ({lint['total']} findings) ==")
+        for rule, agg in sorted(lint["per_rule"].items()):
+            lines.append(
+                f"{rule:<16} {agg['open']:3d} open, "
+                f"{agg['suppressed']:3d} suppressed, "
+                f"{agg['baselined']:3d} baselined")
+        for e in lint["open"]:
+            lines.append(f"  ! {e['path']}:{e['line']}: {e['rule']}: "
+                         f"{e.get('message', '')}")
 
     if memory:
         peak_rss = max(m["host_rss_gib"] for m in memory)

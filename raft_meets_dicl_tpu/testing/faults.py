@@ -40,9 +40,10 @@ Everything here is inert unless ``RMD_FAULT`` is set; the production
 call sites are single dict lookups on the parsed spec.
 """
 
-import os
 import threading
 from pathlib import Path
+
+from ..utils import env
 
 _lock = threading.Lock()
 # parsed spec cache: {spec string: [ (name, params dict), ... ]}
@@ -72,7 +73,7 @@ def _parse(spec):
 
 
 def _directives():
-    spec = os.environ.get("RMD_FAULT", "")
+    spec = env.get_str("RMD_FAULT")
     if not spec:
         return ()
     with _lock:
@@ -83,7 +84,7 @@ def _directives():
 
 def active():
     """Whether any fault directive is armed (cheap env check)."""
-    return bool(os.environ.get("RMD_FAULT"))
+    return bool(env.get_str("RMD_FAULT"))
 
 
 def reset():
@@ -94,7 +95,7 @@ def reset():
 
 
 def _marker(name, params):
-    state = os.environ.get("RMD_FAULT_STATE")
+    state = env.raw("RMD_FAULT_STATE")
     if not state:
         return None
     key = "-".join(f"{k}{v}" for k, v in sorted(params.items()))

@@ -1,4 +1,23 @@
-from . import config, debug, expr, logging, model, seeds, tfdata, vcs
+"""Utility modules, loaded lazily (PEP 562).
 
-__all__ = ["config", "debug", "expr", "logging", "model", "seeds", "tfdata",
-           "vcs"]
+Lazy so that light-weight consumers — decode worker processes, the lint
+framework, ``testing.faults`` — can import ``utils.env`` (dependency-free
+by contract) without dragging in ``utils.model``'s jax import.
+"""
+
+import importlib
+
+_SUBMODULES = ("config", "debug", "env", "expr", "logging", "model", "seeds",
+               "tfdata", "vcs")
+
+__all__ = list(_SUBMODULES)
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(f"module '{__name__}' has no attribute '{name}'")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_SUBMODULES))

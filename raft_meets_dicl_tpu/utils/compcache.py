@@ -18,6 +18,8 @@ TPU-native answer to its "start training immediately" property.
 
 import os
 
+from . import env
+
 DEFAULT_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
     ".jax_cache")
@@ -39,13 +41,13 @@ def enable_persistent_cache(path: str | None = None) -> str | None:
     cache is an optimization, never a correctness dependency.
     """
     global _effective
-    if os.environ.get("RMD_NO_COMPILE_CACHE"):
+    if env.get_bool("RMD_NO_COMPILE_CACHE"):
         _effective = None
         return None
 
     path = (path
-            or os.environ.get("RMD_COMPILE_CACHE")
-            or os.environ.get("RMD_COMPILE_CACHE_DIR")
+            or env.raw("RMD_COMPILE_CACHE")
+            or env.raw("RMD_COMPILE_CACHE_DIR")
             or DEFAULT_DIR)
     try:
         import jax

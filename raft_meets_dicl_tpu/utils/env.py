@@ -1,0 +1,264 @@
+"""Central registry for ``RMD_*`` environment knobs.
+
+Every environment variable the framework reads is declared here — name,
+type, default, one-line doc, and the README section it belongs to — and
+every read site goes through the typed accessors below instead of
+touching ``os.environ`` directly. That buys three things:
+
+1. **One source of truth.** The README's environment-knob table is
+   generated from this registry (``readme_table()``); a knob that exists
+   in code but not in the table (or the reverse) cannot happen silently —
+   ``graftlint``'s ``env-knob``/``env-docs`` rules fail on direct
+   ``os.environ`` reads of ``RMD_*`` names outside this module, on names
+   read but not registered, and on a README table that drifted from the
+   registry.
+2. **Uniform semantics.** Default-on switches (``RMD_TELEMETRY=0``
+   disables), default-off flags (``RMD_DEBUG_MEM=1`` enables), and typed
+   values (int/float/str) each parse exactly one way, instead of every
+   call site re-inventing ``!= "0"`` vs ``bool(get(...))``.
+3. **Greppability.** ``env.get_bool("RMD_PREFETCH")`` names the knob as
+   a literal, so the registry-completeness check (and a human) can find
+   every consumer.
+
+This module must stay dependency-free (no jax/numpy): it is imported by
+loader worker processes and by the lint framework itself.
+"""
+
+import os
+from dataclasses import dataclass
+
+# knob kinds:
+#   switch — default-on boolean; only the literal "0" disables
+#   flag   — default-off boolean; any non-empty value enables
+#   str    — raw string (default may be None)
+#   int    — integer with default
+#   float  — float with default
+_KINDS = ("switch", "flag", "str", "int", "float")
+
+
+@dataclass(frozen=True)
+class Knob:
+    name: str
+    kind: str
+    default: object
+    doc: str
+    section: str
+
+    def __post_init__(self):
+        if self.kind not in _KINDS:
+            raise ValueError(f"unknown knob kind '{self.kind}'")
+
+
+def _k(name, kind, default, doc, section):
+    return (name, Knob(name, kind, default, doc, section))
+
+
+KNOBS = dict([
+    # -- telemetry ---------------------------------------------------------
+    _k("RMD_TELEMETRY", "switch", True,
+       "kill switch for the telemetry sink and jax.monitoring listeners",
+       "telemetry"),
+    _k("RMD_DEBUG_MEM", "flag", False,
+       "print per-epoch memory snapshots even with telemetry disabled",
+       "telemetry"),
+    _k("RMD_FINITE_CHECK_EVERY", "int", 10,
+       "amortized cadence (steps) of the device finiteness fetch / "
+       "pipeline-drain sample", "telemetry"),
+    # -- input pipeline ----------------------------------------------------
+    _k("RMD_WIRE_FORMAT", "str", None,
+       "host-to-device wire format preset (f32 | bf16 | u8); CLI "
+       "--wire-format wins", "input"),
+    _k("RMD_WIRE_BF16", "switch", True,
+       "legacy bf16 image put for mixed-precision models when no wire "
+       "format is configured", "input"),
+    _k("RMD_LOADER_PROCS", "int", 0,
+       "decode worker processes (0 = thread pool); CLI --loader-procs "
+       "wins", "input"),
+    _k("RMD_LOADER_MP", "str", "fork",
+       "multiprocessing start method for the decode pool", "input"),
+    _k("RMD_LOADER_RETRIES", "int", 2,
+       "per-sample decode retries before neighbor substitution", "input"),
+    _k("RMD_BAD_SAMPLE_BUDGET", "int", 16,
+       "substituted-sample budget per loader before aborting (0 disables "
+       "healing)", "input"),
+    _k("RMD_LOADER_TIMEOUT", "float", 300.0,
+       "total seconds to wait for one sample before declaring the decode "
+       "pool wedged", "input"),
+    _k("RMD_LOADER_POLL", "float", 5.0,
+       "decode-pool queue poll interval (dead-worker detection latency)",
+       "input"),
+    _k("RMD_LOADER_RESPAWNS", "int", 3,
+       "dead decode workers respawned before the pool raises PoolBroken",
+       "input"),
+    _k("RMD_EVAL_BUCKETS", "str", None,
+       "shape-bucket spec for evaluation/validation ('group' or "
+       "'HxW,HxW,...')", "input"),
+    # -- training loop -----------------------------------------------------
+    _k("RMD_PREFETCH", "switch", True,
+       "double-buffered host-to-device prefetch (0 = synchronous "
+       "transfer, bit-identical)", "training"),
+    _k("RMD_PREFETCH_DEPTH", "int", 2,
+       "how many batches ahead the prefetch worker runs", "training"),
+    _k("RMD_PREFETCH_PUT", "switch", True,
+       "perform the device_put inside the prefetch worker (0 = put on "
+       "the consumer thread)", "training"),
+    _k("RMD_NONFINITE", "str", None,
+       "non-finite step policy (raise | skip | rollback); CLI "
+       "--nonfinite wins", "training"),
+    _k("RMD_ASYNC_CHECKPOINT", "switch", True,
+       "background checkpoint serialization/write (0 = synchronous "
+       "save)", "training"),
+    # -- SPMD / parallel ---------------------------------------------------
+    _k("RMD_MESH", "str", None,
+       "mesh spec 'DATA,MODEL' (or 'data'); CLI --mesh wins", "parallel"),
+    _k("RMD_ACCUMULATE", "str", None,
+       "in-step gradient accumulation factor; CLI --accumulate wins",
+       "parallel"),
+    # -- compile / AOT -----------------------------------------------------
+    _k("RMD_COMPILE_CACHE", "str", None,
+       "persistent XLA compile-cache directory (default "
+       "<repo>/.jax_cache)", "compile"),
+    _k("RMD_COMPILE_CACHE_DIR", "str", None,
+       "legacy alias of RMD_COMPILE_CACHE", "compile"),
+    _k("RMD_NO_COMPILE_CACHE", "flag", False,
+       "disable the persistent XLA compile cache entirely", "compile"),
+    _k("RMD_AOT", "switch", True,
+       "AOT serialized-executable program store (0 disables)", "compile"),
+    _k("RMD_AOT_DIR", "str", None,
+       "relocate the AOT program store (default "
+       "<compile-cache>/programs)", "compile"),
+    # -- model fast paths --------------------------------------------------
+    _k("RMD_DICL_FAST", "switch", True,
+       "level-batched MatchingNets + fused Pallas window sampler (0 = "
+       "reference loop)", "models"),
+    _k("RMD_WCP_BAND", "switch", True,
+       "band-sharing windowed-correlation Pallas kernel (0 = per-row "
+       "form)", "models"),
+    _k("RMD_FS_VOLUME_GIB", "float", 4.0,
+       "raft/fs correlation-volume HBM budget steering the "
+       "volume/windowed dispatch (per chip)", "models"),
+    # -- fault injection / harness -----------------------------------------
+    _k("RMD_FAULT", "str", "",
+       "deterministic fault injection spec (testing.faults)", "faults"),
+    _k("RMD_FAULT_STATE", "str", None,
+       "directory sharing fired-once fault state across processes",
+       "faults"),
+    _k("RMD_DRYRUN_BUDGET_S", "float", 420.0,
+       "wall-clock budget for the __graft_entry__ multi-chip dry run",
+       "faults"),
+])
+
+_SECTIONS = ("telemetry", "input", "training", "parallel", "compile",
+             "models", "faults")
+
+
+def knob(name):
+    """The :class:`Knob` declaration for ``name`` (KeyError if absent)."""
+    return KNOBS[name]
+
+
+def raw(name):
+    """The raw environment string for a registered knob, or None.
+
+    The escape hatch for call sites that need "was it set at all"
+    precedence logic (CLI > env var > config); everything else should use
+    the typed accessors.
+    """
+    KNOBS[name]
+    return os.environ.get(name)
+
+
+def is_set(name):
+    """Whether the knob is present in the environment at all."""
+    KNOBS[name]
+    return name in os.environ
+
+
+def get(name):
+    """Typed value of a registered knob, falling back to its default."""
+    k = KNOBS[name]
+    v = os.environ.get(name)
+    if k.kind == "switch":
+        return v != "0"
+    if k.kind == "flag":
+        return bool(v)
+    if v is None or (v == "" and k.kind != "str"):
+        return k.default
+    if k.kind == "int":
+        return int(v)
+    if k.kind == "float":
+        return float(v)
+    return v
+
+
+def get_bool(name):
+    """Boolean knob (switch or flag)."""
+    k = KNOBS[name]
+    if k.kind not in ("switch", "flag"):
+        raise TypeError(f"{name} is a {k.kind} knob, not a boolean")
+    return get(name)
+
+
+def get_int(name):
+    return int(get(name))
+
+
+def get_float(name):
+    return float(get(name))
+
+
+def get_str(name):
+    v = get(name)
+    return v if v is None else str(v)
+
+
+# -- README table generation -------------------------------------------------
+
+TABLE_BEGIN = "<!-- env-knobs:begin (generated by utils/env.py) -->"
+TABLE_END = "<!-- env-knobs:end -->"
+
+
+def _default_repr(k):
+    if k.kind == "switch":
+        return "on"
+    if k.kind == "flag":
+        return "off"
+    if k.default is None:
+        return "-"
+    if k.kind == "str" and k.default == "":
+        return "-"
+    return str(k.default)
+
+
+def readme_table():
+    """The generated markdown knob table (without the begin/end markers).
+
+    ``scripts/graftlint.py --fix-knob-table`` writes this between the
+    markers in README.md; the ``env-docs`` lint rule fails when the
+    committed table drifts from the registry.
+    """
+    lines = ["| Knob | Type | Default | Effect |", "|---|---|---|---|"]
+    for section in _SECTIONS:
+        knobs = [k for k in KNOBS.values() if k.section == section]
+        if not knobs:
+            continue
+        lines.append(f"| **{section}** | | | |")
+        for k in sorted(knobs, key=lambda k: k.name):
+            lines.append(
+                f"| `{k.name}` | {k.kind} | {_default_repr(k)} | {k.doc} |")
+    return "\n".join(lines)
+
+
+def splice_readme(text):
+    """Return ``text`` with the region between the knob-table markers
+    replaced by the current :func:`readme_table` output. Raises
+    ValueError when the markers are missing or out of order."""
+    begin = text.find(TABLE_BEGIN)
+    end = text.find(TABLE_END)
+    if begin < 0 or end < 0 or end < begin:
+        raise ValueError(
+            f"README knob-table markers missing ({TABLE_BEGIN!r} ... "
+            f"{TABLE_END!r})")
+    head = text[:begin + len(TABLE_BEGIN)]
+    tail = text[end:]
+    return head + "\n" + readme_table() + "\n" + tail

@@ -1,0 +1,107 @@
+#!/usr/bin/env python3
+"""graftlint CLI: the TPU-hazard lint pass + HLO program auditor.
+
+Static pass (default) — AST rules over the repo's Python surface
+(host-sync, tracer-branch, f32-literal, env-knob, env-docs), with
+line-level ``# graftlint: disable=<rule> -- <reason>`` suppressions and
+the committed ``graftlint-baseline.json`` of grandfathered findings.
+Exit code is 0 iff no finding is *open* (suppressed/baselined don't
+fail) — so CI stays green on the committed tree and goes red the moment
+a new hazard lands without a justification.
+
+HLO pass (``--hlo``) — lowers the registered flagship step programs
+twice each and audits fingerprint stability, collective counts
+(post-GSPMD), f32 convolutions, and baked-in constants. Needs jax; the
+static pass does not.
+
+    python scripts/graftlint.py                  # lint, human-readable
+    python scripts/graftlint.py --json           # machine-readable
+    python scripts/graftlint.py --baseline b.json --root /path/to/repo
+    python scripts/graftlint.py --fix-knob-table # regenerate README table
+    python scripts/graftlint.py --hlo            # add the program audit
+    python scripts/graftlint.py --events out.jsonl  # findings as telemetry
+"""
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+from raft_meets_dicl_tpu.analysis import lint  # noqa: E402
+
+
+def fix_knob_table(root):
+    from raft_meets_dicl_tpu.utils import env
+
+    readme = Path(root) / "README.md"
+    text = readme.read_text()
+    new = env.splice_readme(text)
+    if new == text:
+        print("README knob table already up to date")
+        return 0
+    readme.write_text(new)
+    print("README knob table regenerated from utils.env.KNOBS")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=str(Path(__file__).parent.parent),
+                    help="repo root to lint (default: this checkout)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline JSON (default: <root>/"
+                         f"{lint.BASELINE_NAME} if present)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full report as JSON on stdout")
+    ap.add_argument("--fix-knob-table", action="store_true",
+                    help="regenerate the README env-knob table and exit")
+    ap.add_argument("--hlo", action="store_true",
+                    help="also lower + audit the registered flagship "
+                         "programs (requires jax)")
+    ap.add_argument("--events", default=None, metavar="FILE",
+                    help="append findings as 'lint' telemetry events")
+    args = ap.parse_args(argv)
+
+    if args.fix_knob_table:
+        return fix_knob_table(args.root)
+
+    baseline = (lint.Baseline.load(args.baseline)
+                if args.baseline else None)
+    report = lint.run(args.root, baseline=baseline)
+
+    hlo_reports, hlo_findings = [], []
+    if args.hlo:
+        from raft_meets_dicl_tpu.analysis import hlo
+
+        hlo_reports, hlo_findings = hlo.audit_registry()
+        report.findings.extend(hlo_findings)
+
+    if args.events:
+        from raft_meets_dicl_tpu import telemetry
+
+        tele = telemetry.Telemetry(args.events)
+        try:
+            lint.emit_events(report, tele)
+        finally:
+            tele.close()
+
+    if args.json:
+        out = report.to_dict()
+        if args.hlo:
+            out["hlo"] = hlo_reports
+        json.dump(out, sys.stdout, indent=2)
+        print()
+    else:
+        print(lint.render_text(report))
+        if args.hlo:
+            from raft_meets_dicl_tpu.analysis import hlo
+
+            print(hlo.render_reports(hlo_reports))
+
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
