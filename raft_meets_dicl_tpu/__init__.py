@@ -33,7 +33,11 @@ Layout (mirrors the reference's layer map, SURVEY.md §1, redesigned TPU-first):
 - ``telemetry/`` — run-wide structured telemetry: span timers, versioned
                   JSONL event sink (``events.jsonl`` per run), compile /
                   memory / anomaly events, report rendering.
-- ``cmd/``      — CLI subcommands (train / evaluate / checkpoint / gencfg).
+- ``serve/``    — online inference: continuous shape-bucketed batching,
+                  admission control, warm compiled-program pools, the
+                  open-loop SLO load generator.
+- ``cmd/``      — CLI subcommands (train / evaluate / checkpoint / gencfg
+                  / serve).
 """
 
 __version__ = "0.1.0"
@@ -45,6 +49,7 @@ from . import (  # noqa: E402
     models,
     ops,
     parallel,
+    serve,
     strategy,
     telemetry,
     utils,
@@ -54,5 +59,5 @@ from . import inspect  # noqa: E402  (module name mirrors the reference)
 
 __all__ = [
     "data", "evaluation", "inspect", "metrics", "models", "ops", "parallel",
-    "strategy", "telemetry", "utils", "visual",
+    "serve", "strategy", "telemetry", "utils", "visual",
 ]

@@ -1,10 +1,11 @@
 from . import checkpoint as checkpoint_mod
 from . import eval as eval_mod
-from . import gencfg, train as train_mod
+from . import gencfg, serve as serve_mod, train as train_mod
 
 train = train_mod.train
 evaluate = eval_mod.evaluate
 checkpoint = checkpoint_mod.checkpoint
 generate_config = gencfg.generate_config
+serve = serve_mod.serve
 
-__all__ = ["train", "evaluate", "checkpoint", "generate_config"]
+__all__ = ["train", "evaluate", "checkpoint", "generate_config", "serve"]

@@ -1,0 +1,164 @@
+"""The ``serve`` subcommand: online flow inference as a service.
+
+Boots one replica (model + warm compiled-program pool), then either:
+
+- ``--prebuild``: compile and AOT-export every (model, bucket, wire)
+  triple of the serve config and exit — the deploy-time warm-pool
+  builder (a replica booting against the exported store serves its
+  first request with zero compiles);
+- default: run the built-in open-loop load generator against the
+  scheduler and print the SLO report (p50/p99 latency, pairs/s,
+  shed/error counts) as JSON — the in-process serving harness the
+  network frontend will mount.
+
+Knob precedence everywhere: CLI flag > config file (``serve:`` section)
+> ``RMD_SERVE_*`` environment knob > registered default.
+"""
+
+import json
+import logging
+from pathlib import Path
+
+from .. import models, serve as serving, utils
+
+
+def _pick(cli, cfg, cfg_key, env_value):
+    if cli is not None:
+        return cli
+    if cfg_key in cfg:
+        return cfg[cfg_key]
+    return env_value
+
+
+def _resolve(path, cfg_path):
+    """Config-file-relative path resolution (same contract as the data
+    layer's spec refs): a relative path inside the serve config means
+    "next to this file", not "under whatever CWD the CLI ran from"."""
+    if cfg_path is None or Path(path).is_absolute():
+        return path
+    return str(Path(cfg_path).parent / path)
+
+
+def serve(args):
+    utils.logging.setup()
+
+    from .. import compile as programs, telemetry
+    from ..utils import compcache, env
+
+    tele = telemetry.get()
+    if getattr(args, "telemetry", None):
+        tele = telemetry.activate(telemetry.create(Path(args.telemetry)))
+        if tele.path:
+            logging.info(f"writing telemetry events to '{tele.path}'")
+    tele.emit(
+        "boot",
+        compile_cache=compcache.effective_dir(),
+        aot_dir=str(programs.programs_dir()) if programs.aot_enabled()
+        else None,
+        aot=programs.aot_enabled(),
+    )
+
+    import jax
+
+    from .train import select_devices
+
+    devices = select_devices(args.device, args.device_ids)
+    jax.config.update("jax_default_device", devices[0])
+
+    cfg = {}
+    if getattr(args, "config", None):
+        cfg = utils.config.load(args.config)
+        cfg = cfg.get("serve", cfg)
+
+    model_src = args.model
+    if model_src is None:
+        model_src = cfg.get("model")
+        if isinstance(model_src, str):
+            model_src = _resolve(model_src, getattr(args, "config", None))
+    if model_src is None:
+        raise ValueError("serve needs a model: --model or the config's "
+                         "'model' key")
+    model_cfg = (utils.config.load(model_src) if isinstance(model_src, str)
+                 else model_src)
+    if "strategy" in model_cfg:
+        model_cfg = model_cfg["model"]
+    spec = models.load(model_cfg)
+    logging.info(f"serving model '{spec.id}'")
+
+    from ..models.input import ShapeBuckets
+    from ..models.wire import WireFormat
+
+    buckets_spec = _pick(args.buckets, cfg, "buckets",
+                         env.raw("RMD_SERVE_BUCKETS"))
+    buckets = ShapeBuckets.from_config(buckets_spec)
+    if buckets is None or not buckets.sizes:
+        raise ValueError(
+            "serve needs explicit bucket sizes: --buckets 'HxW,...', the "
+            "config's 'buckets' key, or RMD_SERVE_BUCKETS")
+    logging.info(f"shape buckets: {buckets.describe()}")
+
+    wire_cfg = _pick(getattr(args, "wire_format", None), cfg, "wire-format",
+                     env.get_str("RMD_WIRE_FORMAT"))
+    wire = WireFormat.from_config(wire_cfg)
+    if wire is not None:
+        logging.info(f"request wire format: {wire.describe()}")
+
+    batch_size = int(_pick(args.batch_size, cfg, "batch-size",
+                           env.get_int("RMD_SERVE_BATCH")))
+    checkpoint = args.checkpoint
+    if checkpoint is None and cfg.get("checkpoint") is not None:
+        checkpoint = _resolve(cfg["checkpoint"],
+                              getattr(args, "config", None))
+
+    session = serving.ServeSession(
+        spec, buckets, wire=wire, checkpoint=checkpoint,
+        batch_size=batch_size)
+
+    outcomes = session.warm_pool()
+    for o in outcomes:
+        logging.info(
+            f"warm pool: {o['model']} bucket {o['bucket']} batch "
+            f"{o['batch']} [{o['wire']}] — {o['compiles']} compiles, "
+            f"{o['aot_hits']} AOT hits, {o['aot_saves']} AOT saves "
+            f"({o['seconds']:.2f} s)")
+
+    if getattr(args, "prebuild", False):
+        print(json.dumps({"prebuild": outcomes}))
+        if getattr(args, "telemetry", None):
+            telemetry.deactivate()
+        return
+
+    max_wait_ms = float(_pick(args.max_wait_ms, cfg, "max-wait-ms",
+                              env.get_float("RMD_SERVE_MAX_WAIT_MS")))
+    queue_limit = int(_pick(args.queue_limit, cfg, "queue-limit",
+                            env.get_int("RMD_SERVE_QUEUE")))
+
+    scheduler = serving.Scheduler(
+        session, batch_size=batch_size, max_wait_ms=max_wait_ms,
+        queue_limit=queue_limit).start()
+
+    # built-in open-loop client: every bucket size plus an off-bucket
+    # variant of each (exercises quantization + partial batches)
+    shapes = []
+    for h, w in session.buckets.sizes:
+        shapes.append((h, w))
+        if h > 8 and w > 8:
+            shapes.append((h - 8, w - 8))
+
+    requests = int(_pick(args.requests, cfg, "requests", 32))
+    rate = float(_pick(args.rate, cfg, "rate", 50.0))
+    logging.info(f"open-loop load: {requests} requests at {rate}/s over "
+                 f"{len(shapes)} shapes")
+
+    report = serving.loadgen.run_open_loop(
+        scheduler, shapes, requests=requests, rate_hz=rate)
+    scheduler.stop(drain=True)
+
+    logging.info(
+        f"served {report['completed']}/{report['requests']} requests: "
+        f"p50 {report['p50_ms']:.1f} ms, p99 {report['p99_ms']:.1f} ms, "
+        f"{report['pairs_per_sec']:.2f} pairs/s")
+    print(json.dumps(report))
+
+    if getattr(args, "telemetry", None):
+        telemetry.deactivate()

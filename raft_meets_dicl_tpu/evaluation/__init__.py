@@ -300,10 +300,7 @@ def warmup_eval_fn(eval_fn, variables, shapes, batch_size, wire=None,
     jit/persistent/AOT cache instead of overcounting one per shape (the
     pre-PR-7 fallback).
     """
-    if wire is not None:
-        dtype = wire.encode_image(np.zeros((1, 1, 1, 3), np.float32)).dtype
-    else:
-        dtype = np.float32
+    dtype = wire.image_dtype() if wire is not None else np.float32
 
     counter = _program_compile_counter(eval_fn)
     for h, w in shapes:

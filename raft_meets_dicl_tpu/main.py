@@ -196,6 +196,59 @@ def main():
                             "compile attribution, AOT hits/misses) to "
                             "this JSONL file")
 
+    # subcommand: serve
+    serve = subp.add_parser("serve", formatter_class=fmtcls,
+                            help="serve flow inference (continuous "
+                                 "shape-bucketed batching)")
+    serve.add_argument("-c", "--config",
+                       help="serve configuration (yaml/json with a "
+                            "'serve' section; CLI flags win)")
+    serve.add_argument("-m", "--model", help="model specification to serve")
+    serve.add_argument("--checkpoint", help="checkpoint to load")
+    serve.add_argument("--buckets", metavar="SPEC",
+                       help="canonical request shapes, comma-separated "
+                            "HxW list, e.g. '384x1280,448x1024' "
+                            "(required; also: RMD_SERVE_BUCKETS or the "
+                            "config's 'buckets' key)")
+    serve.add_argument("--wire-format", choices=["f32", "bf16", "u8"],
+                       help="request wire format: compact image dtype "
+                            "decoded inside the jitted program "
+                            "[default: host-normalized f32]")
+    serve.add_argument("-b", "--batch-size", type=int,
+                       help="device batch size per dispatch (also: "
+                            "RMD_SERVE_BATCH) [default: 4]")
+    serve.add_argument("--max-wait-ms", type=float,
+                       help="max time a partial batch waits before "
+                            "dispatching padded (also: "
+                            "RMD_SERVE_MAX_WAIT_MS) [default: 50]")
+    serve.add_argument("--queue-limit", type=int,
+                       help="per-bucket admission queue bound; overload "
+                            "sheds with a typed rejection (also: "
+                            "RMD_SERVE_QUEUE) [default: 64]")
+    serve.add_argument("--prebuild", action="store_true",
+                       help="compile + AOT-export every (model, bucket, "
+                            "wire) program triple and exit (deploy-time "
+                            "warm-pool build)")
+    serve.add_argument("--requests", type=int,
+                       help="built-in open-loop client: request count "
+                            "[default: 32]")
+    serve.add_argument("--rate", type=float,
+                       help="built-in open-loop client: submissions/s "
+                            "[default: 50]")
+    serve.add_argument("--device",
+                       help="jax platform to use (tpu, cpu) [default: backend default]")
+    serve.add_argument("--device-ids",
+                       help="comma-separated device indices")
+    serve.add_argument("--compile-cache", metavar="DIR",
+                       help="persistent XLA compile cache directory "
+                            "(also: RMD_COMPILE_CACHE) "
+                            "[default: <repo>/.jax_cache]; AOT program "
+                            "store in DIR/programs (RMD_AOT=0 disables)")
+    serve.add_argument("--telemetry", metavar="PATH",
+                       help="write serve telemetry events (request "
+                            "spans, batches, rejects, warm-pool "
+                            "outcomes) to this JSONL file")
+
     # subcommand: checkpoint
     chkpt = subp.add_parser("checkpoint", formatter_class=fmtcls,
                             help="inspect and manage checkpoints")
@@ -254,6 +307,7 @@ def main():
         "e": cmd.evaluate,
         "eval": cmd.evaluate,
         "gencfg": cmd.generate_config,
+        "serve": cmd.serve,
         "train": cmd.train,
         "t": cmd.train,
     }

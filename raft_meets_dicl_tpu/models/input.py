@@ -322,6 +322,28 @@ class ShapeBuckets:
             args["constant_values"], clip, range)
         return out
 
+    def pad_image(self, img, bucket):
+        """Pad a single HWC (or NHWC) image up to ``bucket`` bottom/right.
+
+        The serving admission path pads each request's images directly to
+        their assigned bucket (``check_compatible`` guarantees buckets
+        satisfy the model's modulo constraint, so no intermediate modulo
+        pad is needed); on a ``raw_variant`` the constant translates into
+        raw space exactly like the batch path.
+        """
+        h, w = img.shape[-3], img.shape[-2]
+        bh, bw = bucket
+        if (h, w) == (bh, bw):
+            return img
+
+        mode, args = _PAD_MODE_ALIASES.get(self.mode, (self.mode, {}))
+        raw = getattr(self, "_raw_constant", None)
+        if raw is not None and "constant_values" in args:
+            args = dict(args, constant_values=raw)
+
+        pad = [(0, 0)] * (img.ndim - 3) + [(0, bh - h), (0, bw - w), (0, 0)]
+        return np.pad(img, pad, mode=mode, **args)
+
     def pad(self, img1, img2, flow, valid, meta):
         """Pad one sample batch up to its bucket (no-op when no bucket
         fits or the sample already sits on one)."""

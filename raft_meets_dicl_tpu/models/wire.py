@@ -113,6 +113,15 @@ class WireFormat:
         return (f"images={self.images}, flow={self.flow}, "
                 f"valid={'packed' if self.pack_valid else 'bool'}")
 
+    def image_dtype(self):
+        """The numpy dtype image arrays take on the wire (what warmup
+        dummies and serving buffers must be created in)."""
+        if self.images == "bf16":
+            return _bf16()
+        if self.images == "u8":
+            return np.dtype(np.uint8)
+        return np.dtype(np.float32)
+
     # -- host side (numpy) --------------------------------------------------
 
     def encode_image(self, img):

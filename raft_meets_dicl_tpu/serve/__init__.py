@@ -1,0 +1,30 @@
+"""Flow-as-a-service: the online inference path.
+
+Composes the training-side ingredients into a request path — canonical
+``ShapeBuckets`` quantization (PR 4), compact wire formats decoded inside
+the jitted program (PR 2), the compiled-program registry with AOT export
+(PR 7), structured telemetry (PR 1) — behind a continuous-batching
+scheduler with bounded-queue admission control:
+
+- :mod:`.batcher` — request/result types, typed rejection/error classes,
+  per-bucket coalescing with deterministic batch selection (numpy-only);
+- :mod:`.scheduler` — admission, the dispatch loop, sticky per-client
+  response ordering, per-request latency spans;
+- :mod:`.session` — the model replica: variables, the registered eval
+  program, and the warm pool of precompiled executables per
+  (model, bucket, wire) triple;
+- :mod:`.loadgen` — the open-loop synthetic load generator behind
+  ``BENCH_SERVE=1`` and the ``serve`` CLI's built-in client.
+"""
+
+from . import batcher, loadgen, scheduler, session
+from .batcher import (BucketBatcher, FlowRequest, FlowResult, ServeError,
+                      ServeRejected)
+from .scheduler import Scheduler, Ticket
+from .session import ServeSession
+
+__all__ = [
+    "batcher", "loadgen", "scheduler", "session",
+    "BucketBatcher", "FlowRequest", "FlowResult", "ServeError",
+    "ServeRejected", "Scheduler", "Ticket", "ServeSession",
+]

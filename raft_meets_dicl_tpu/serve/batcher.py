@@ -1,0 +1,182 @@
+"""Request types + per-bucket coalescing for the serving path.
+
+The batcher is the host-side half of continuous batching: every admitted
+request is quantized onto the canonical :class:`~..models.input.ShapeBuckets`
+set at admission (so its compiled program is known before it ever queues),
+then coalesced with same-bucket neighbors into full device batches. A
+bucket whose queue reaches the batch size dispatches immediately; a
+partial batch dispatches once its oldest request has waited the configured
+deadline, filled up to the full batch size by tiling the last request —
+the eval-style ``pad_to=`` treatment — so it rides the full batch's
+compiled program instead of compiling one per remainder size.
+
+This module is numpy-only (no jax): everything device-side lives in the
+scheduler/session.
+"""
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+
+class ServeRejected(RuntimeError):
+    """Typed admission rejection: the request never entered the system.
+
+    ``reason`` is the machine-readable shed class (``queue_full`` for
+    backpressure). Sheds are the admission-control contract — the
+    dispatch loop never stalls to absorb overload; callers retry or
+    back off.
+    """
+
+    def __init__(self, reason, detail=""):
+        self.reason = reason
+        super().__init__(f"request rejected ({reason})"
+                         + (f": {detail}" if detail else ""))
+
+
+class ServeError(RuntimeError):
+    """Typed per-request failure.
+
+    ``kind`` is one of:
+
+    - ``malformed`` — the payload failed validation at admission;
+    - ``oversized`` — the pair fits no configured bucket (no compiled
+      program exists for it);
+    - ``decode`` — the request failed while its batch was being
+      prepared/decoded (the rest of the batch is unaffected);
+    - ``internal`` — the dispatch failed; the batch's requests all carry
+      this error, the loop continues.
+    """
+
+    def __init__(self, kind, detail=""):
+        self.kind = kind
+        super().__init__(f"request failed ({kind})"
+                         + (f": {detail}" if detail else ""))
+
+
+@dataclass
+class FlowRequest:
+    """One admitted image pair, already quantized and wire-encoded.
+
+    ``img1``/``img2`` are bucket-shaped arrays in the wire dtype (the
+    admission path pads raw pixels up to the bucket and encodes them, so
+    the dispatch loop only stacks). ``shape`` keeps the original (H, W)
+    for cropping the response.
+    """
+
+    rid: int
+    client: str
+    seq: int
+    bucket: Tuple[int, int]
+    shape: Tuple[int, int]
+    img1: np.ndarray
+    img2: np.ndarray
+    ticket: Any
+    t_submit: float
+    t_enqueue: float = 0.0
+    spans: Dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class FlowResult:
+    """One served flow: cropped to the request's original extent, with
+    the per-request latency spans (seconds) the telemetry event carries:
+    ``admission`` (validate + quantize + encode), ``queue`` (enqueue to
+    dispatch), ``dispatch`` (batch assembly + program call), ``device``
+    (result fetch)."""
+
+    rid: int
+    client: str
+    bucket: Tuple[int, int]
+    shape: Tuple[int, int]
+    flow: np.ndarray
+    spans: Dict[str, float]
+
+
+class BucketBatcher:
+    """Bounded per-bucket FIFO queues + deterministic batch selection.
+
+    Selection policy (documented because tests pin it): full batches
+    first — among buckets holding at least ``batch_size`` requests, the
+    one whose head request enqueued earliest wins (ties broken by bucket
+    size, ascending). With no full batch, the oldest head whose wait
+    exceeded the caller's deadline dispatches as a partial. Within a
+    bucket, order is strict FIFO. Everything keys on the monotonic
+    enqueue stamp plus the bucket tuple, so the same submission sequence
+    always coalesces identically.
+    """
+
+    def __init__(self, buckets, batch_size, queue_limit):
+        if not buckets.sizes:
+            raise ValueError(
+                "serving needs explicit bucket sizes ('HxW,...'): the "
+                "warm program pool is built per bucket")
+        self.buckets = buckets
+        self.batch_size = int(batch_size)
+        self.queue_limit = int(queue_limit)
+        self._queues = {b: deque() for b in buckets.sizes}
+
+    def assign(self, h, w) -> Optional[Tuple[int, int]]:
+        """Smallest bucket fitting (h, w), or None (oversized)."""
+        return self.buckets.assign(h, w)
+
+    def encode_pair(self, img1, img2, bucket, encode):
+        """Pad a raw HWC pair up to ``bucket`` and wire-encode it."""
+        img1 = self.buckets.pad_image(img1, bucket)
+        img2 = self.buckets.pad_image(img2, bucket)
+        return encode(img1), encode(img2)
+
+    def offer(self, request) -> bool:
+        """Enqueue, or refuse (bucket queue at bound — backpressure)."""
+        q = self._queues[request.bucket]
+        if len(q) >= self.queue_limit:
+            return False
+        request.t_enqueue = time.perf_counter()
+        q.append(request)
+        return True
+
+    def pending(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    def take(self, now, max_wait_s, drain=False):
+        """Next dispatchable batch, or the wake-up deadline.
+
+        Returns ``(bucket, requests)`` when a batch should dispatch now,
+        else ``(None, deadline)`` where ``deadline`` is the absolute
+        ``perf_counter`` time the oldest partial becomes dispatchable
+        (None when every queue is empty). ``drain`` dispatches partials
+        immediately (shutdown flush).
+        """
+        full = [(q[0].t_enqueue, b) for b, q in self._queues.items()
+                if len(q) >= self.batch_size]
+        if full:
+            _, bucket = min(full)
+            return bucket, self._pop(bucket)
+
+        heads = [(q[0].t_enqueue, b) for b, q in self._queues.items() if q]
+        if not heads:
+            return None, None
+        t_head, bucket = min(heads)
+        if drain or now - t_head >= max_wait_s:
+            return bucket, self._pop(bucket)
+        return None, t_head + max_wait_s
+
+    def _pop(self, bucket):
+        q = self._queues[bucket]
+        return [q.popleft() for _ in range(min(len(q), self.batch_size))]
+
+    def assemble(self, requests):
+        """Stack a batch's encoded pairs, filling up to ``batch_size``
+        by tiling the last request (partial batches ride the full
+        batch's compiled program; filled outputs are dropped by the
+        response crop). Returns ``(img1, img2, fill)``."""
+        img1 = np.stack([r.img1 for r in requests])
+        img2 = np.stack([r.img2 for r in requests])
+        fill = self.batch_size - len(requests)
+        if fill > 0:
+            img1 = np.concatenate([img1, np.repeat(img1[-1:], fill, axis=0)])
+            img2 = np.concatenate([img2, np.repeat(img2[-1:], fill, axis=0)])
+        return img1, img2, fill

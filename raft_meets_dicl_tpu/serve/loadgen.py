@@ -1,0 +1,90 @@
+"""Open-loop synthetic load generator: the SLO measurement harness.
+
+Open-loop means requests fire on a fixed wall-clock schedule regardless
+of completions — the honest way to measure a service under load (a
+closed loop self-throttles and hides queueing delay, the classic
+coordinated-omission trap). The generator cycles through a
+mixed-resolution shape list, submits raw synthetic pairs at ``rate_hz``,
+collects every ticket, and reports p50/p99/mean latency, per-span means,
+throughput, and the shed/error counts.
+"""
+
+import time
+
+import numpy as np
+
+from ..telemetry.report import _percentile
+from .batcher import ServeError, ServeRejected
+
+
+def synthetic_pair(shape, rng):
+    """One deterministic pseudo-random raw image pair in [0, 1)."""
+    h, w = shape
+    img1 = rng.random((h, w, 3), dtype=np.float32)
+    img2 = rng.random((h, w, 3), dtype=np.float32)
+    return img1, img2
+
+
+def run_open_loop(scheduler, shapes, requests, rate_hz, client="loadgen",
+                  seed=0, result_timeout_s=120.0):
+    """Drive ``scheduler`` with ``requests`` submissions at ``rate_hz``.
+
+    ``shapes`` is the (H, W) cycle the stream draws from (mixed
+    resolutions exercise bucket quantization and partial batches).
+    Returns the report dict (see ``summarize``); deterministic for a
+    fixed seed and shape list.
+    """
+    rng = np.random.default_rng(seed)
+    interval = 1.0 / float(rate_hz)
+    tickets = []
+    rejects = {}
+    errors = {}
+
+    t_start = time.perf_counter()
+    for i in range(int(requests)):
+        target = t_start + i * interval
+        delay = target - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        img1, img2 = synthetic_pair(shapes[i % len(shapes)], rng)
+        try:
+            tickets.append(scheduler.submit(img1, img2, client=client))
+        except ServeRejected as e:
+            rejects[e.reason] = rejects.get(e.reason, 0) + 1
+        except ServeError as e:
+            errors[e.kind] = errors.get(e.kind, 0) + 1
+
+    results = []
+    for ticket in tickets:
+        try:
+            results.append(ticket.result(timeout=result_timeout_s))
+        except ServeError as e:
+            errors[e.kind] = errors.get(e.kind, 0) + 1
+    wall = time.perf_counter() - t_start
+
+    return summarize(int(requests), results, rejects, errors, wall)
+
+
+def summarize(requests, results, rejects, errors, wall_s):
+    """Aggregate completed :class:`FlowResult`s into the SLO report."""
+    latencies = sorted(r.spans.get("total", 0.0) for r in results)
+    span_names = sorted({k for r in results for k in r.spans})
+    spans_ms = {}
+    for name in span_names:
+        vals = [r.spans[name] for r in results if name in r.spans]
+        spans_ms[name] = round(1e3 * sum(vals) / len(vals), 3)
+
+    completed = len(results)
+    return {
+        "requests": requests,
+        "completed": completed,
+        "rejected": rejects,
+        "errors": errors,
+        "wall_s": round(wall_s, 3),
+        "pairs_per_sec": round(completed / wall_s, 3) if wall_s > 0 else 0.0,
+        "p50_ms": round(1e3 * _percentile(latencies, 0.50), 3),
+        "p99_ms": round(1e3 * _percentile(latencies, 0.99), 3),
+        "mean_ms": (round(1e3 * sum(latencies) / completed, 3)
+                    if completed else 0.0),
+        "spans_ms": spans_ms,
+    }

@@ -1,0 +1,297 @@
+"""Continuous-batching request scheduler for the serving path.
+
+One dispatch thread pulls batches from the :class:`BucketBatcher` and
+runs them through a :class:`~.session.ServeSession`; callers submit image
+pairs from any thread and block on the returned :class:`Ticket`. Three
+invariants the tests pin:
+
+- **The dispatch loop never stalls.** Overload sheds at admission with a
+  typed :class:`ServeRejected` (bounded per-bucket queues); a request
+  that fails mid-flight (fault-injected decode error, device failure)
+  completes its ticket with a typed :class:`ServeError` while the rest of
+  its batch — and the loop — carry on.
+- **No batch poisoning.** Per-request failures are removed from the
+  batch before assembly; the surviving requests still dispatch (refilled
+  to the full batch size by tiling, so they keep the same compiled
+  program).
+- **Sticky per-client ordering.** Responses release to each client in
+  submission order: a finished ticket whose predecessor (same client) is
+  still in flight is held until the predecessor completes, so clients
+  can stream results without reordering buffers.
+
+This module is host-side only (no jax import — device work lives in the
+session); per-request telemetry lands as ``serve`` events: ``request``
+(success, with admission/queue/dispatch/device spans), ``error``,
+``reject``, and per-dispatch ``batch`` records.
+"""
+
+import threading
+import time
+
+import numpy as np
+
+from .. import telemetry
+from ..testing import faults
+from ..utils import env
+from .batcher import (BucketBatcher, FlowRequest, FlowResult, ServeError,
+                      ServeRejected)
+
+
+class Ticket:
+    """Caller handle for one admitted request: blocks on :meth:`result`
+    until the scheduler releases the response (in per-client submission
+    order)."""
+
+    def __init__(self, rid, client):
+        self.rid = rid
+        self.client = client
+        self._event = threading.Event()
+        self._result = None
+        self._error = None
+
+    def _complete(self, result=None, error=None):
+        self._result = result
+        self._error = error
+        self._event.set()
+
+    def done(self):
+        return self._event.is_set()
+
+    def result(self, timeout=None):
+        """The :class:`FlowResult`, or raises the request's typed
+        :class:`ServeError`; ``TimeoutError`` if nothing arrives in
+        ``timeout`` seconds."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(f"request {self.rid} still in flight "
+                               f"after {timeout} s")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class Scheduler:
+    """Admission control + dispatch loop over one serve session.
+
+    ``batch_size``/``max_wait_ms``/``queue_limit`` default to the
+    session's batch size and the ``RMD_SERVE_MAX_WAIT_MS`` /
+    ``RMD_SERVE_QUEUE`` knobs.
+    """
+
+    def __init__(self, session, batch_size=None, max_wait_ms=None,
+                 queue_limit=None):
+        if batch_size is None:
+            batch_size = session.batch_size
+        if max_wait_ms is None:
+            max_wait_ms = env.get_float("RMD_SERVE_MAX_WAIT_MS")
+        if queue_limit is None:
+            queue_limit = env.get_int("RMD_SERVE_QUEUE")
+        self.session = session
+        self.batcher = BucketBatcher(session.buckets, batch_size, queue_limit)
+        self.max_wait_s = float(max_wait_ms) / 1e3
+
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._rid = 0
+        self._seq = {}            # client -> next sequence number to assign
+        self._release_next = {}   # client -> next sequence number to release
+        self._held = {}           # client -> {seq: (request, result, error)}
+        self._stopping = False
+        self._thread = None
+
+    # -- admission (caller threads) -----------------------------------------
+
+    def submit(self, img1, img2, client="default"):
+        """Admit one raw (un-normalized f32 HWC) image pair.
+
+        Returns a :class:`Ticket` on acceptance. Raises synchronously:
+        :class:`ServeError` (``malformed``/``oversized``) when the
+        payload can never be served, :class:`ServeRejected`
+        (``queue_full``/``shutdown``) when the system sheds it —
+        admission is where backpressure surfaces, the dispatch loop never
+        blocks on overload.
+        """
+        t0 = time.perf_counter()
+        with self._lock:
+            rid = self._rid
+            self._rid += 1
+
+        try:
+            self._validate(rid, img1, img2)
+            h, w = int(img1.shape[0]), int(img1.shape[1])
+            bucket = self.batcher.assign(h, w)
+            if bucket is None or faults.fire("serve_oversized", index=rid):
+                raise ServeError(
+                    "oversized",
+                    f"{h}x{w} fits no bucket ({self.session.buckets.describe()})")
+        except ServeError as e:
+            # field name is 'error' (not 'kind'): the envelope's 'kind'
+            # slot is the event kind itself
+            telemetry.get().emit("serve", event="error", rid=rid,
+                                 client=client, error=e.kind)
+            raise
+
+        e1, e2 = self.batcher.encode_pair(img1, img2, bucket,
+                                          self.session.encode_image)
+        ticket = Ticket(rid, client)
+        req = FlowRequest(rid=rid, client=client, seq=0, bucket=bucket,
+                          shape=(h, w), img1=e1, img2=e2, ticket=ticket,
+                          t_submit=t0)
+
+        with self._cond:
+            if self._stopping:
+                telemetry.get().emit("serve", event="reject", rid=rid,
+                                     client=client, reason="shutdown")
+                raise ServeRejected("shutdown")
+            req.spans["admission"] = time.perf_counter() - t0
+            if not self.batcher.offer(req):
+                telemetry.get().emit(
+                    "serve", event="reject", rid=rid, client=client,
+                    reason="queue_full", bucket=f"{bucket[0]}x{bucket[1]}")
+                raise ServeRejected(
+                    "queue_full",
+                    f"bucket {bucket[0]}x{bucket[1]} queue at bound "
+                    f"({self.batcher.queue_limit})")
+            req.seq = self._seq.get(client, 0)
+            self._seq[client] = req.seq + 1
+            self._cond.notify()
+        return ticket
+
+    def _validate(self, rid, img1, img2):
+        if faults.fire("serve_malformed", index=rid):
+            raise ServeError("malformed", "fault injected")
+        for img in (img1, img2):
+            if not isinstance(img, np.ndarray) or img.ndim != 3 \
+                    or img.shape[-1] != 3:
+                raise ServeError(
+                    "malformed",
+                    f"expected HWC RGB arrays, got "
+                    f"{getattr(img, 'shape', type(img).__name__)}")
+        if img1.shape != img2.shape:
+            raise ServeError(
+                "malformed", f"pair shapes differ: {img1.shape} vs "
+                             f"{img2.shape}")
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._loop, name="serve-dispatch", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, drain=True):
+        """Stop admitting; by default drain queued requests (partials
+        dispatch immediately), otherwise fail them with a typed error."""
+        with self._cond:
+            self._stopping = True
+            if not drain:
+                flushed = []
+                while True:
+                    bucket, batch = self.batcher.take(
+                        time.perf_counter(), 0.0, drain=True)
+                    if bucket is None:
+                        break
+                    flushed.extend(batch)
+                self._cond.notify_all()
+            else:
+                flushed = []
+                self._cond.notify_all()
+        for r in flushed:
+            self._complete(r, error=ServeError("internal", "shutdown"))
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    def pending(self):
+        with self._lock:
+            return self.batcher.pending()
+
+    # -- dispatch loop -------------------------------------------------------
+
+    def _loop(self):
+        while True:
+            with self._cond:
+                while True:
+                    now = time.perf_counter()
+                    bucket, batch = self.batcher.take(
+                        now, self.max_wait_s, drain=self._stopping)
+                    if bucket is not None:
+                        break
+                    if self._stopping:
+                        return
+                    deadline = batch  # (None, deadline) overload of take()
+                    timeout = (None if deadline is None
+                               else max(0.0, deadline - now))
+                    self._cond.wait(timeout)
+            try:
+                self._dispatch(bucket, batch)
+            except Exception as e:  # noqa: BLE001 - loop must survive
+                for r in batch:
+                    self._complete(r, error=ServeError("internal", str(e)))
+
+    def _dispatch(self, bucket, batch):
+        t0 = time.perf_counter()
+
+        # per-request decode faults: remove the poisoned request, keep the
+        # rest of the batch (assemble refills to the full size by tiling)
+        live = []
+        for r in batch:
+            if faults.fire("serve_decode_error", index=r.rid):
+                self._complete(
+                    r, error=ServeError("decode", "fault injected"))
+            else:
+                live.append(r)
+        if not live:
+            return
+        for r in live:
+            r.spans["queue"] = t0 - r.t_enqueue
+
+        img1, img2, fill = self.batcher.assemble(live)
+        c0 = self.session.compiles()
+        flow = self.session.run(img1, img2)
+        t1 = time.perf_counter()
+        flow = self.session.fetch(flow)
+        t2 = time.perf_counter()
+
+        telemetry.get().emit(
+            "serve", event="batch", bucket=f"{bucket[0]}x{bucket[1]}",
+            size=len(live), fill=fill,
+            compiles=self.session.compiles() - c0,
+            seconds=round(t1 - t0, 6))
+
+        for i, r in enumerate(live):
+            h, w = r.shape
+            r.spans["dispatch"] = t1 - t0
+            r.spans["device"] = t2 - t1
+            self._complete(r, result=FlowResult(
+                rid=r.rid, client=r.client, bucket=bucket, shape=r.shape,
+                flow=flow[i, :h, :w, :], spans=r.spans))
+
+    # -- completion / sticky per-client release ------------------------------
+
+    def _complete(self, req, result=None, error=None):
+        with self._lock:
+            held = self._held.setdefault(req.client, {})
+            held[req.seq] = (req, result, error)
+            nxt = self._release_next.get(req.client, 0)
+            ready = []
+            while nxt in held:
+                ready.append(held.pop(nxt))
+                nxt += 1
+            self._release_next[req.client] = nxt
+        for r, res, err in ready:
+            total = time.perf_counter() - r.t_submit
+            tele = telemetry.get()
+            if err is None:
+                res.spans["total"] = total
+                tele.emit(
+                    "serve", event="request", rid=r.rid, client=r.client,
+                    bucket=f"{r.bucket[0]}x{r.bucket[1]}",
+                    seconds=round(total, 6),
+                    spans={k: round(v, 6) for k, v in res.spans.items()})
+            else:
+                tele.emit("serve", event="error", rid=r.rid,
+                          client=r.client,
+                          error=getattr(err, "kind", "internal"),
+                          seconds=round(total, 6))
+            r.ticket._complete(result=res, error=err)

@@ -1,0 +1,167 @@
+"""One serving replica: model + variables + warm compiled-program pool.
+
+The session owns everything device-side: the model spec, its variables
+(freshly initialized or checkpoint-restored), the registered eval program
+(``evaluation.make_eval_fn`` with the stable model id, so the program
+dedupes process-wide and round-trips the AOT store), and the warm pool —
+one precompiled executable per (model, bucket, wire) triple at the serve
+batch size. A replica prepared with :meth:`warm_pool` against a populated
+AOT store serves its first request with zero compiles; without artifacts
+it pays at most one compile per bucket, up front instead of on the first
+unlucky request.
+"""
+
+import logging
+import time
+
+import numpy as np
+
+from .. import evaluation, models, telemetry
+from ..models.input import ShapeBuckets
+
+
+class ServeSession:
+    """Device-side half of the serving path.
+
+    ``spec`` is a loaded ``models.ModelSpec``; ``buckets`` the canonical
+    ``ShapeBuckets`` (explicit sizes required — the warm pool is built
+    per bucket); ``wire`` an optional ``WireFormat`` (bound to the
+    model's clip/range here). Submitted images are raw un-normalized f32;
+    with a wire format they cross host→device compact and decode inside
+    the jitted program, without one they are normalized on the host by
+    :meth:`encode_image`.
+    """
+
+    def __init__(self, spec, buckets, wire=None, checkpoint=None,
+                 batch_size=4, mesh=None):
+        buckets = ShapeBuckets.from_config(buckets) \
+            if not isinstance(buckets, ShapeBuckets) else buckets
+        if buckets is None or not buckets.sizes:
+            raise ValueError(
+                "serving needs explicit bucket sizes ('HxW,...'): the "
+                "warm program pool and admission control are per bucket")
+        self.spec = spec
+        self.model = spec.model
+        self.input = spec.input
+        buckets.check_compatible(self.input.padding)
+
+        if wire is not None:
+            wire = wire.bound(self.input.clip, self.input.range)
+        self.wire = wire
+        # requests pad raw pixels then encode/normalize, so bucket pad
+        # constants translate into raw space (same as the wire loaders)
+        self.buckets = buckets.raw_variant(self.input.clip, self.input.range)
+        self.batch_size = int(batch_size)  # graftlint: disable=host-sync -- config scalar, not a device value
+        self.mesh = mesh
+
+        self.variables = self._init_variables(checkpoint)
+        self.eval_fn = evaluation.make_eval_fn(
+            self.model, None, mesh=mesh, wire=wire, model_id=spec.id)
+
+    @classmethod
+    def from_config(cls, model_cfg, buckets, **kwargs):
+        """Build from a model config mapping (full training configs
+        accepted — their ``model`` section is used)."""
+        if "strategy" in model_cfg:
+            model_cfg = model_cfg["model"]
+        return cls(models.load(model_cfg), buckets, **kwargs)
+
+    def _init_variables(self, checkpoint):
+        import jax
+
+        # structure init at the smallest bucket; init wants the
+        # normalized f32 contract, not the wire dtype
+        h, w = self.buckets.sizes[0]
+        dummy = self._normalize(np.zeros((1, h, w, 3), np.float32))
+        variables = self.model.init(jax.random.PRNGKey(0), dummy, dummy)
+        if checkpoint is not None:
+            from .. import strategy
+
+            logging.info(f"loading checkpoint, file='{checkpoint}'")
+            chkpt = strategy.Checkpoint.load(checkpoint)
+            variables, _, _ = chkpt.apply(variables=variables)
+        return variables
+
+    def _normalize(self, img):
+        lo, hi = self.input.clip
+        rmin, rmax = self.input.range
+        x = np.clip(np.asarray(img, np.float32), lo, hi)  # graftlint: disable=host-sync -- host-side raw request pixels, never a device array
+        return (rmax - rmin) * x + rmin
+
+    # -- request encoding (host, admission path) -----------------------------
+
+    def encode_image(self, img):
+        """Raw un-normalized image → what the program's inputs expect:
+        wire dtype (decode runs inside the jit) or host-normalized f32."""
+        if self.wire is not None:
+            return self.wire.encode_image(img)
+        return self._normalize(img)
+
+    def image_dtype(self):
+        return (self.wire.image_dtype() if self.wire is not None
+                else np.dtype(np.float32))
+
+    # -- device work (dispatch thread) ---------------------------------------
+
+    def run(self, img1, img2):
+        """One batch through the eval program; returns the final flow as
+        a ready device array (NHWC, f32)."""
+        import jax
+
+        _, flow = self.eval_fn(self.variables, img1, img2)
+        # the dispatch span must cover device compute: the scheduler's
+        # only pipeline stage is this call, there is no async overlap to
+        # preserve
+        jax.block_until_ready(flow)  # graftlint: disable=host-sync -- serving dispatch-span boundary
+        return flow
+
+    def fetch(self, flow):
+        """Device flow → host numpy (the per-request ``device`` span)."""
+        import jax
+
+        return np.asarray(jax.device_get(flow))  # graftlint: disable=host-sync -- response must materialize on host
+
+    def compiles(self):
+        """Exact backend-compile count of the serve program (registry
+        Program counter; see evaluation._program_compile_counter)."""
+        return getattr(self.eval_fn, "compiles", 0)
+
+    # -- warm pool ------------------------------------------------------------
+
+    def warm_pool(self):
+        """Compile (or AOT-load) the program for every bucket at the
+        serve batch size; returns one outcome record per (model, bucket,
+        wire) triple: compiles / AOT hits / AOT saves / seconds.
+
+        With a populated AOT store every triple reports ``compiles=0,
+        aot_hits=1``; a prebuild run (``serve --prebuild``) reports the
+        saves it exported.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        step = self.eval_fn
+        dtype = self.image_dtype()
+        outcomes = []
+        for h, w in self.buckets.sizes:
+            t0 = time.perf_counter()
+            c0 = self.compiles()
+            h0 = getattr(step, "aot_hits", 0)
+            s0 = getattr(step, "aot_saves", 0)
+            img = jnp.zeros((self.batch_size, h, w, 3), dtype)
+            _, flow = step(self.variables, img, img)
+            jax.block_until_ready(flow)  # graftlint: disable=host-sync -- warm pool must finish before serving starts
+            outcome = {
+                "model": self.spec.id,
+                "bucket": f"{h}x{w}",
+                "wire": (self.wire.describe() if self.wire is not None
+                         else "f32 host-normalized"),
+                "batch": self.batch_size,
+                "compiles": self.compiles() - c0,
+                "aot_hits": getattr(step, "aot_hits", 0) - h0,
+                "aot_saves": getattr(step, "aot_saves", 0) - s0,
+                "seconds": round(time.perf_counter() - t0, 4),
+            }
+            outcomes.append(outcome)
+            telemetry.get().emit("serve", event="warmup", **outcome)
+        return outcomes

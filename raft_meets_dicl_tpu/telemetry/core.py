@@ -78,6 +78,13 @@ SCHEMA = {
     # finding when the lint pass runs with a telemetry sink attached;
     # status is open | baselined | suppressed, severity error | warn
     "lint": {"rule", "path", "line", "status"},
+    # serving path (serve/): event is request (success, with
+    # admission/queue/dispatch/device latency spans) | error (typed
+    # per-request failure, kind = malformed | oversized | decode |
+    # internal) | reject (admission shed, reason = queue_full |
+    # shutdown) | batch (one dispatch: bucket, size, fill, compiles) |
+    # warmup (one warm-pool triple: compiles, AOT hits/saves)
+    "serve": {"event"},
     "preempt": {"signal", "step"},
     "resume": {"path", "step"},
     "quarantine": {"path"},

@@ -286,6 +286,62 @@ def eval_stats(events):
     return out
 
 
+def serve_stats(events):
+    """Aggregate the serving path's ``serve`` events: request latency
+    percentiles, per-span means, typed rejects/errors, per-bucket batch
+    and compile counts, and warm-pool outcomes (PR 10)."""
+    requests = []
+    rejects = {}
+    errors = {}
+    buckets = {}
+    warmups = []
+    spans = {}
+    for e in events:
+        if e["kind"] != "serve":
+            continue
+        ev = e.get("event")
+        if ev == "request":
+            requests.append(e)
+            for name, secs in e.get("spans", {}).items():
+                spans.setdefault(name, []).append(secs)
+        elif ev == "reject":
+            reason = e.get("reason", "?")
+            rejects[reason] = rejects.get(reason, 0) + 1
+        elif ev == "error":
+            err = e.get("error", "?")
+            errors[err] = errors.get(err, 0) + 1
+        elif ev == "batch":
+            b = buckets.setdefault(e.get("bucket", "?"), {
+                "batches": 0, "requests": 0, "fill": 0, "compiles": 0})
+            b["batches"] += 1
+            b["requests"] += e.get("size", 0)
+            b["fill"] += e.get("fill", 0)
+            b["compiles"] += e.get("compiles", 0)
+        elif ev == "warmup":
+            warmups.append(e)
+    if not (requests or rejects or errors or buckets or warmups):
+        return None
+
+    latencies = sorted(e.get("seconds", 0.0) for e in requests)
+    return {
+        "requests": len(requests),
+        "rejects": rejects,
+        "errors": errors,
+        "p50_s": _percentile(latencies, 0.50),
+        "p99_s": _percentile(latencies, 0.99),
+        "mean_s": (sum(latencies) / len(latencies) if latencies else 0.0),
+        "spans_s": {name: sum(vals) / len(vals)
+                    for name, vals in sorted(spans.items())},
+        "buckets": buckets,
+        "warmups": [{
+            "model": w.get("model", "?"), "bucket": w.get("bucket", "?"),
+            "wire": w.get("wire", "?"), "compiles": w.get("compiles", 0),
+            "aot_hits": w.get("aot_hits", 0),
+            "aot_saves": w.get("aot_saves", 0),
+        } for w in warmups],
+    }
+
+
 def sharding_stats(events):
     """Per-stage SPMD placement summaries from ``sharding`` events: mesh
     shape and the per-chip vs. replicated byte accounting the partitioner
@@ -412,6 +468,43 @@ def render(events, errors=(), warmup_steps=DEFAULT_WARMUP_STEPS,
                     f"  bucket {key:<12} {b['samples']:>6d} samples in "
                     f"{b['batches']} batches, {b.get('compiles', 0)} "
                     "compiles")
+
+    srv = serve_stats(events)
+    if srv:
+        lines.append("")
+        lines.append("== serving ==")
+        shed = sum(srv["rejects"].values())
+        errs = sum(srv["errors"].values())
+        summary = f"requests: {srv['requests']} served"
+        if shed:
+            detail = ", ".join(f"{r}={n}" for r, n in
+                               sorted(srv["rejects"].items()))
+            summary += f", {shed} rejected ({detail})"
+        if errs:
+            detail = ", ".join(f"{k}={n}" for k, n in
+                               sorted(srv["errors"].items()))
+            summary += f", {errs} errors ({detail})"
+        lines.append(summary)
+        if srv["requests"]:
+            lines.append(
+                f"latency: p50 {srv['p50_s'] * 1e3:.1f} ms, "
+                f"p99 {srv['p99_s'] * 1e3:.1f} ms, "
+                f"mean {srv['mean_s'] * 1e3:.1f} ms")
+            spans = srv["spans_s"]
+            if spans:
+                lines.append("spans:   " + ", ".join(
+                    f"{name} {secs * 1e3:.1f} ms"
+                    for name, secs in spans.items()))
+        for key, b in sorted(srv["buckets"].items()):
+            lines.append(
+                f"  bucket {key:<12} {b['requests']:>6d} requests in "
+                f"{b['batches']} batches ({b['fill']} pad fill), "
+                f"{b['compiles']} compiles")
+        for w in srv["warmups"]:
+            lines.append(
+                f"  warm pool {w['model']}[{w['bucket']}] ({w['wire']}): "
+                f"{w['compiles']} compiles, {w['aot_hits']} AOT hits, "
+                f"{w['aot_saves']} AOT saves")
 
     aot = aot_stats(events)
     if aot["boot"] or aot["programs"]:

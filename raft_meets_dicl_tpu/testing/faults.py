@@ -28,6 +28,19 @@ the call sites that consult them:
 ``decode_error@index=I[;times=T]``
     the sample pipeline raises on sample index I, T times (default 1) —
     the loader's bounded retry / substitute path must absorb it.
+``serve_malformed@index=I``
+    serve.scheduler rejects request id I at admission as a malformed
+    payload — the submit call must raise the typed ServeError without
+    the request ever entering a queue.
+``serve_oversized@index=I``
+    serve.scheduler treats request id I as fitting no configured bucket
+    (shape outside every bucket) — typed oversized ServeError at
+    admission.
+``serve_decode_error@index=I[;times=T]``
+    serve.scheduler fails request id I during batch preparation — the
+    request's ticket must complete with a typed decode ServeError while
+    the rest of its batch still dispatches (no poisoning, no dispatch-
+    loop stall).
 
 Firing is once per directive by default (``times`` raises the budget).
 Counters are per-process; when a fault must fire exactly once *across*

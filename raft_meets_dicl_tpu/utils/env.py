@@ -137,6 +137,19 @@ KNOBS = dict([
     _k("RMD_FS_VOLUME_GIB", "float", 4.0,
        "raft/fs correlation-volume HBM budget steering the "
        "volume/windowed dispatch (per chip)", "models"),
+    # -- serving -----------------------------------------------------------
+    _k("RMD_SERVE_BUCKETS", "str", None,
+       "canonical request shapes for the serve command ('HxW,HxW,...'); "
+       "CLI --buckets / config wins", "serve"),
+    _k("RMD_SERVE_BATCH", "int", 4,
+       "serve device batch size per dispatch; CLI --batch-size / config "
+       "wins", "serve"),
+    _k("RMD_SERVE_MAX_WAIT_MS", "float", 50.0,
+       "max milliseconds a partial batch waits before dispatching padded "
+       "onto the full batch's program", "serve"),
+    _k("RMD_SERVE_QUEUE", "int", 64,
+       "per-bucket admission queue bound; requests beyond it shed with a "
+       "typed queue_full rejection", "serve"),
     # -- fault injection / harness -----------------------------------------
     _k("RMD_FAULT", "str", "",
        "deterministic fault injection spec (testing.faults)", "faults"),
@@ -149,7 +162,7 @@ KNOBS = dict([
 ])
 
 _SECTIONS = ("telemetry", "input", "training", "parallel", "compile",
-             "models", "faults")
+             "models", "serve", "faults")
 
 
 def knob(name):

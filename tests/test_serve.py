@@ -1,0 +1,470 @@
+"""Serving-path tests: scheduler core, backpressure, request faults.
+
+The scheduler/batcher mechanics (bucket coalescing determinism, partial
+padding, bounded-queue sheds, sticky per-client ordering, request-level
+fault degradation) run against a host-only fake session — no jax, so the
+invariants are pinned fast and in isolation. The device half (partial
+batches bit-exactly riding the full batch's compiled program, the warm
+pool's zero-compile AOT contract) runs a real tiny model.
+"""
+
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import raft_meets_dicl_tpu.models as models
+from raft_meets_dicl_tpu import evaluation, serve, telemetry
+from raft_meets_dicl_tpu import compile as programs
+from raft_meets_dicl_tpu.models.input import ShapeBuckets
+from raft_meets_dicl_tpu.models.wire import WireFormat
+from raft_meets_dicl_tpu.serve import (
+    BucketBatcher, ServeError, ServeRejected, ServeSession, Scheduler,
+)
+from raft_meets_dicl_tpu.telemetry import report as treport
+from raft_meets_dicl_tpu.testing import faults
+
+pytestmark = pytest.mark.serve
+
+REPO = Path(__file__).parent.parent
+
+TINY_SERVE_MODEL = {
+    "name": "serve tiny", "id": "serve-tiny",
+    "model": {"type": "raft/baseline",
+              "parameters": {"corr-levels": 2, "corr-radius": 2,
+                             "corr-channels": 32, "context-channels": 16,
+                             "recurrent-channels": 16},
+              "arguments": {"iterations": 2}},
+    "loss": {"type": "raft/sequence"},
+    "input": {"padding": {"type": "modulo", "mode": "zeros",
+                          "size": [8, 8]}},
+}
+
+
+@pytest.fixture(autouse=True)
+def _serve_hygiene(monkeypatch):
+    """Every test starts unarmed with a fresh memory telemetry sink."""
+    monkeypatch.delenv("RMD_FAULT", raising=False)
+    monkeypatch.delenv("RMD_FAULT_STATE", raising=False)
+    faults.reset()
+    sink = telemetry.activate(telemetry.Telemetry())
+    yield sink
+    telemetry.deactivate()
+    faults.reset()
+
+
+def _serve_events(sink, event):
+    return [e for e in sink.events
+            if e["kind"] == "serve" and e["event"] == event]
+
+
+def _pair(shape, seed=0):
+    rng = np.random.default_rng(seed)
+    h, w = shape
+    return (rng.random((h, w, 3), dtype=np.float32),
+            rng.random((h, w, 3), dtype=np.float32))
+
+
+class FakeSession:
+    """Host-only stand-in for ServeSession: the 'flow' is a deterministic
+    numpy function of the encoded inputs, so scheduler mechanics are
+    testable without any device work."""
+
+    def __init__(self, buckets, batch_size=4, delay_s=0.0):
+        self.buckets = buckets
+        self.batch_size = batch_size
+        self.delay_s = delay_s
+        self.batch_shapes = []
+
+    def encode_image(self, img):
+        return np.asarray(img, np.float32) * 2.0 - 1.0
+
+    def compiles(self):
+        return 0
+
+    def run(self, img1, img2):
+        self.batch_shapes.append(img1.shape)
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return (img1 + img2)[..., :2]
+
+    def fetch(self, flow):
+        return np.asarray(flow)
+
+
+def _fake_scheduler(batch_size=2, max_wait_ms=5.0, queue_limit=64,
+                    delay_s=0.0):
+    buckets = ShapeBuckets([(16, 24), (32, 48)])
+    session = FakeSession(buckets, batch_size=batch_size, delay_s=delay_s)
+    return Scheduler(session, batch_size=batch_size,
+                     max_wait_ms=max_wait_ms, queue_limit=queue_limit)
+
+
+def _offer(batcher, rid, bucket, client="c"):
+    h, w = bucket
+    img = np.zeros((h, w, 3), np.float32)
+    req = serve.FlowRequest(rid=rid, client=client, seq=rid, bucket=bucket,
+                            shape=(h, w), img1=img, img2=img, ticket=None,
+                            t_submit=time.perf_counter())
+    assert batcher.offer(req)
+    return req
+
+
+# -- batcher core -------------------------------------------------------------
+
+
+def test_bucket_assignment_smallest_fit():
+    buckets = ShapeBuckets([(32, 48), (16, 24), (32, 32)])
+    b = BucketBatcher(buckets, batch_size=2, queue_limit=8)
+    assert b.assign(10, 20) == (16, 24)    # smallest area that fits
+    assert b.assign(16, 24) == (16, 24)    # exact fit
+    assert b.assign(20, 30) == (32, 32)    # skips too-small buckets
+    assert b.assign(30, 40) == (32, 48)
+    assert b.assign(33, 20) is None        # oversized
+    assert b.assign(20, 60) is None
+
+
+def test_take_full_batches_first_then_fifo():
+    buckets = ShapeBuckets([(16, 24), (32, 48)])
+    b = BucketBatcher(buckets, batch_size=2, queue_limit=8)
+    # older partial in the small bucket, then a full batch in the big one
+    r0 = _offer(b, 0, (16, 24))
+    r1 = _offer(b, 1, (32, 48))
+    r2 = _offer(b, 2, (32, 48))
+    now = time.perf_counter()
+    bucket, batch = b.take(now, max_wait_s=60.0)
+    assert bucket == (32, 48)              # full beats older partial
+    assert [r.rid for r in batch] == [1, 2]  # strict FIFO within bucket
+    # the partial hasn't expired: take reports its wake-up deadline
+    bucket, deadline = b.take(now, max_wait_s=60.0)
+    assert bucket is None
+    assert deadline == pytest.approx(r0.t_enqueue + 60.0)
+    # expired (or drained) partials dispatch
+    bucket, batch = b.take(r0.t_enqueue + 61.0, max_wait_s=60.0)
+    assert bucket == (16, 24) and [r.rid for r in batch] == [0]
+
+
+def test_take_is_deterministic_for_a_submission_sequence():
+    def coalesce():
+        buckets = ShapeBuckets([(16, 24), (32, 48)])
+        b = BucketBatcher(buckets, batch_size=2, queue_limit=16)
+        order = [(16, 24), (32, 48), (16, 24), (32, 48), (16, 24)]
+        for rid, bucket in enumerate(order):
+            _offer(b, rid, bucket)
+        batches = []
+        while True:
+            bucket, batch = b.take(time.perf_counter() + 1e6,
+                                   max_wait_s=0.0, drain=True)
+            if bucket is None:
+                break
+            batches.append((bucket, [r.rid for r in batch]))
+        return batches
+
+    assert coalesce() == coalesce()
+    assert coalesce() == [((16, 24), [0, 2]), ((32, 48), [1, 3]),
+                          ((16, 24), [4])]
+
+
+def test_assemble_fills_partial_by_tiling_last():
+    buckets = ShapeBuckets([(16, 24)])
+    b = BucketBatcher(buckets, batch_size=3, queue_limit=8)
+    r = _offer(b, 0, (16, 24))
+    r.img1 = np.random.default_rng(0).random((16, 24, 3)).astype(np.float32)
+    r.img2 = r.img1 + 1.0
+    img1, img2, fill = b.assemble([r])
+    assert fill == 2
+    assert img1.shape == (3, 16, 24, 3)
+    np.testing.assert_array_equal(img1[1], img1[0])
+    np.testing.assert_array_equal(img1[2], img1[0])
+    np.testing.assert_array_equal(img2[1], img2[0])
+
+
+# -- scheduler: admission, backpressure, ordering, faults ---------------------
+
+
+def test_scheduler_round_trip_and_spans(_serve_hygiene):
+    sched = _fake_scheduler(batch_size=2, max_wait_ms=2.0).start()
+    try:
+        img1, img2 = _pair((14, 20))
+        t = sched.submit(img1, img2)
+        res = t.result(timeout=10.0)
+    finally:
+        sched.stop(drain=True)
+    assert res.bucket == (16, 24)
+    assert res.shape == (14, 20)
+    assert res.flow.shape == (14, 20, 2)
+    # the fake 'flow' is encode(img1)+encode(img2), cropped to the raw
+    # extent — padding never leaks into the response
+    want = (img1 * 2 - 1) + (img2 * 2 - 1)
+    np.testing.assert_allclose(res.flow, want[..., :2], rtol=1e-6)
+    for span in ("admission", "queue", "dispatch", "device", "total"):
+        assert span in res.spans
+    ev = _serve_events(_serve_hygiene, "request")
+    assert len(ev) == 1 and ev[0]["rid"] == 0
+    assert ev[0]["bucket"] == "16x24"
+    bev = _serve_events(_serve_hygiene, "batch")
+    assert len(bev) == 1 and bev[0]["size"] == 1 and bev[0]["fill"] == 1
+
+
+def test_backpressure_sheds_at_queue_bound(_serve_hygiene):
+    # not started: nothing drains the queues, so the bound is reachable
+    sched = _fake_scheduler(batch_size=4, queue_limit=2, max_wait_ms=1e4)
+    img1, img2 = _pair((14, 20))
+    sched.submit(img1, img2)
+    sched.submit(img1, img2)
+    with pytest.raises(ServeRejected) as exc:
+        sched.submit(img1, img2)
+    assert exc.value.reason == "queue_full"
+    ev = _serve_events(_serve_hygiene, "reject")
+    assert len(ev) == 1
+    assert ev[0]["reason"] == "queue_full" and ev[0]["bucket"] == "16x24"
+    # the shed request never consumed a sequence slot: draining the two
+    # admitted ones still releases both
+    sched.start()
+    sched.stop(drain=True)
+    assert len(_serve_events(_serve_hygiene, "request")) == 2
+
+
+def test_sticky_per_client_release_order():
+    sched = _fake_scheduler(batch_size=1, max_wait_ms=1e4)  # never started
+    img1, img2 = _pair((14, 20))
+    tickets = [sched.submit(img1, img2, client="a") for _ in range(3)]
+    batches = []
+    for _ in range(3):
+        bucket, batch = sched.batcher.take(time.perf_counter(), 0.0,
+                                           drain=True)
+        batches.append((bucket, batch))
+    # complete out of order: 2 first — it must be held until 0 and 1 land
+    sched._dispatch(*batches[2])
+    assert not tickets[2].done()
+    sched._dispatch(*batches[0])
+    assert tickets[0].done() and not tickets[2].done()
+    sched._dispatch(*batches[1])
+    assert tickets[1].done() and tickets[2].done()
+    rids = [t.result(timeout=1.0).rid for t in tickets]
+    assert rids == [0, 1, 2]
+
+
+def test_malformed_and_oversized_are_typed_at_admission(_serve_hygiene,
+                                                        monkeypatch):
+    sched = _fake_scheduler()
+    img1, img2 = _pair((14, 20))
+    with pytest.raises(ServeError) as exc:
+        sched.submit(np.zeros((14, 20), np.float32), img2)
+    assert exc.value.kind == "malformed"
+    with pytest.raises(ServeError) as exc:
+        sched.submit(img1, _pair((16, 20))[1])
+    assert exc.value.kind == "malformed"
+    with pytest.raises(ServeError) as exc:
+        sched.submit(*_pair((64, 64)))  # fits no bucket
+    assert exc.value.kind == "oversized"
+    # fault-injected variants (the request-level faults harness)
+    monkeypatch.setenv("RMD_FAULT",
+                       "serve_malformed@index=3,serve_oversized@index=4")
+    with pytest.raises(ServeError) as exc:
+        sched.submit(img1, img2)
+    assert exc.value.kind == "malformed"
+    with pytest.raises(ServeError) as exc:
+        sched.submit(img1, img2)
+    assert exc.value.kind == "oversized"
+    kinds = [e["error"] for e in _serve_events(_serve_hygiene, "error")]
+    assert kinds == ["malformed", "malformed", "oversized", "malformed",
+                     "oversized"]
+    assert sched.pending() == 0  # nothing ever queued
+
+
+def test_decode_fault_degrades_without_poisoning(_serve_hygiene,
+                                                 monkeypatch):
+    # rid 1 fails during batch preparation; rid 0 (same batch) must still
+    # serve, and the dispatch loop must keep taking work afterwards
+    monkeypatch.setenv("RMD_FAULT", "serve_decode_error@index=1")
+    sched = _fake_scheduler(batch_size=2, max_wait_ms=2.0).start()
+    try:
+        img1, img2 = _pair((14, 20))
+        t0 = sched.submit(img1, img2)
+        t1 = sched.submit(img1, img2)
+        res0 = t0.result(timeout=10.0)
+        with pytest.raises(ServeError) as exc:
+            t1.result(timeout=10.0)
+        assert exc.value.kind == "decode"
+        assert res0.flow.shape == (14, 20, 2)
+        # loop alive: a later request still round-trips
+        t2 = sched.submit(img1, img2)
+        assert t2.result(timeout=10.0).rid == 2
+    finally:
+        sched.stop(drain=True)
+    bev = _serve_events(_serve_hygiene, "batch")
+    # the poisoned request was removed before assembly: first batch
+    # dispatched size 1 (refilled by tiling), second size 1
+    assert [e["size"] for e in bev] == [1, 1]
+    errs = _serve_events(_serve_hygiene, "error")
+    assert len(errs) == 1 and errs[0]["error"] == "decode"
+
+
+def test_stop_without_drain_fails_queued_typed():
+    sched = _fake_scheduler(batch_size=4, max_wait_ms=1e4).start()
+    img1, img2 = _pair((14, 20))
+    t = sched.submit(img1, img2)
+    sched.stop(drain=False)
+    with pytest.raises(ServeError) as exc:
+        t.result(timeout=5.0)
+    assert exc.value.kind == "internal"
+    with pytest.raises(ServeRejected) as exc:
+        sched.submit(img1, img2)
+    assert exc.value.reason == "shutdown"
+
+
+def test_loadgen_open_loop_summary():
+    sched = _fake_scheduler(batch_size=2, max_wait_ms=2.0).start()
+    try:
+        report = serve.loadgen.run_open_loop(
+            sched, [(14, 20), (16, 24), (30, 40)], requests=9,
+            rate_hz=500.0)
+    finally:
+        sched.stop(drain=True)
+    assert report["requests"] == 9 and report["completed"] == 9
+    assert report["rejected"] == {} and report["errors"] == {}
+    assert report["p50_ms"] <= report["p99_ms"]
+    assert report["pairs_per_sec"] > 0
+    for span in ("admission", "queue", "dispatch", "device", "total"):
+        assert span in report["spans_ms"]
+
+
+def test_serve_report_section_renders(_serve_hygiene):
+    monkeypatch_events = _serve_hygiene
+    sched = _fake_scheduler(batch_size=2, max_wait_ms=2.0, queue_limit=1)
+    img1, img2 = _pair((14, 20))
+    t = sched.submit(img1, img2)
+    with pytest.raises(ServeRejected):
+        sched.submit(img1, img2)  # queue bound 1: typed shed
+    sched.start()
+    sched.stop(drain=True)
+    t.result(timeout=5.0)
+    stats = treport.serve_stats(monkeypatch_events.events)
+    assert stats["requests"] == 1
+    assert stats["rejects"] == {"queue_full": 1}
+    assert stats["buckets"]["16x24"]["requests"] == 1
+    text = treport.render(monkeypatch_events.events)
+    assert "== serving ==" in text
+    assert "queue_full" in text
+    assert "bucket 16x24" in text
+
+
+# -- device half: real tiny model --------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_session():
+    spec = models.load(TINY_SERVE_MODEL)
+    return ServeSession(spec, ShapeBuckets([(32, 48)]),
+                        wire=WireFormat.from_config("u8"), batch_size=2)
+
+
+def test_partial_batch_rides_full_batch_program(tiny_session):
+    session = tiny_session
+    session.warm_pool()
+    c0 = session.compiles()
+    sched = Scheduler(session, max_wait_ms=1.0).start()
+    try:
+        img1, img2 = _pair((28, 40), seed=7)
+        res = sched.submit(img1, img2).result(timeout=60.0)
+    finally:
+        sched.stop(drain=True)
+    assert res.flow.shape == (28, 40, 2)
+    # serving — including the partial batch — compiled nothing new
+    assert session.compiles() == c0
+
+    # bit-exact: the same pair tiled to the full batch size through the
+    # program directly must produce the identical cropped flow
+    e1, e2 = sched.batcher.encode_pair(img1, img2, (32, 48),
+                                       session.encode_image)
+    b1 = np.stack([e1, e1])
+    b2 = np.stack([e2, e2])
+    flow = session.fetch(session.run(b1, b2))
+    np.testing.assert_array_equal(res.flow, flow[0, :28, :40, :])
+
+
+def test_warm_pool_prebuild_then_zero_compile_replica(tmp_path,
+                                                      _serve_hygiene):
+    cfg = dict(TINY_SERVE_MODEL, id="serve-aot", name="serve aot")
+    buckets = [(32, 48)]
+    programs.enable_aot(str(tmp_path))
+    try:
+        programs.reset()
+        evaluation._EVAL_FN_CACHE.clear()
+        s1 = ServeSession(models.load(cfg), ShapeBuckets(buckets),
+                          wire=WireFormat.from_config("u8"), batch_size=2)
+        out1 = s1.warm_pool()
+        assert [o["compiles"] for o in out1] == [1]
+        assert [o["aot_saves"] for o in out1] == [1]
+
+        # "new replica": drop every in-process program and model object;
+        # only the exported artifacts remain
+        programs.reset()
+        evaluation._EVAL_FN_CACHE.clear()
+        s2 = ServeSession(models.load(cfg), ShapeBuckets(buckets),
+                          wire=WireFormat.from_config("u8"), batch_size=2)
+        out2 = s2.warm_pool()
+        assert [o["compiles"] for o in out2] == [0]
+        assert [o["aot_hits"] for o in out2] == [1]
+
+        # and it actually serves
+        sched = Scheduler(s2, max_wait_ms=1.0).start()
+        try:
+            res = sched.submit(*_pair((30, 44))).result(timeout=60.0)
+        finally:
+            sched.stop(drain=True)
+        assert res.flow.shape == (30, 44, 2)
+        assert s2.compiles() == 0
+    finally:
+        programs.disable_aot()
+    warm = _serve_events(_serve_hygiene, "warmup")
+    assert len(warm) == 2
+    assert warm[0]["aot_saves"] == 1 and warm[1]["aot_hits"] == 1
+
+
+@pytest.mark.slow
+def test_cli_serve_smoke(tmp_path):
+    import yaml
+
+    (tmp_path / "model.yaml").write_text(yaml.safe_dump(TINY_SERVE_MODEL))
+    (tmp_path / "serve.yaml").write_text(yaml.safe_dump({
+        "serve": {
+            "model": "./model.yaml",
+            "buckets": "32x48",
+            "wire-format": "u8",
+            "batch-size": 2,
+            "max-wait-ms": 5,
+            "requests": 6,
+            "rate": 50,
+        }
+    }))
+    import os
+    import re
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["XLA_FLAGS"] = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "",
+        env.get("XLA_FLAGS", "")).strip()
+    env["RMD_AOT_DIR"] = str(tmp_path / "programs")
+    env["RMD_COMPILE_CACHE"] = str(tmp_path / "xla-cache")
+
+    pre = subprocess.run(
+        [sys.executable, str(REPO / "main.py"), "serve", "-c", "serve.yaml",
+         "--prebuild"],
+        cwd=tmp_path, env=env, capture_output=True, text=True, timeout=600)
+    assert pre.returncode == 0, pre.stderr[-2000:]
+    built = json.loads(pre.stdout.strip().splitlines()[-1])
+    assert built["prebuild"][0]["aot_saves"] >= 0
+
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "main.py"), "serve", "-c", "serve.yaml"],
+        cwd=tmp_path, env=env, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert report["completed"] == 6
+    assert report["p50_ms"] <= report["p99_ms"]
