@@ -514,6 +514,173 @@ def _bench_serve():
     return result
 
 
+def _bench_ladder():
+    """Iteration-ladder frontier (``BENCH_LADDER=1``): EPE-vs-latency
+    across fixed recurrence budgets plus the adaptive policy, per model
+    family.
+
+    Synthetic constant-shift pairs (img2 is img1 rolled by a known
+    offset) give an exact ground-truth flow, so EPE is measurable without
+    a dataset. For each family: every fixed rung (4/8/12 iterations) is
+    one compiled rung program timed over the eval set; the adaptive
+    policy starts at the base rung and escalates through continuation
+    programs while the batch's flow-delta norm exceeds a threshold.
+
+    The threshold is *calibrated from the measurement itself*: at random
+    init the delta signal never shrinks (untrained GRU updates don't
+    converge), so a fixed production threshold would escalate every
+    batch. Calibrating to an upper quantile (``BENCH_LADDER_PCTL``,
+    default 90) of the measured base-rung deltas emulates the converged-
+    model operating point — most requests stop at the base rung, the
+    stragglers pay for continuation rungs — which is the regime the
+    ladder is built for. ``adaptive.vs_full`` reports the latency ratio
+    and EPE regression against the monolithic full budget — the
+    acceptance frontier. One cumulative JSON line per family; consumers
+    read the last."""
+    from raft_meets_dicl_tpu import evaluation, models
+
+    cpu = jax.default_backend() == "cpu"
+    rungs = tuple(int(r) for r in
+                  os.environ.get("BENCH_LADDER_RUNGS", "4,8,12").split(","))
+    pctl = float(os.environ.get("BENCH_LADDER_PCTL", "90"))
+    if cpu:
+        h, w, batch, n_batches = 64, 96, 2, 8
+        tiny = {"corr-levels": 2, "corr-radius": 2, "corr-channels": 32,
+                "context-channels": 16, "recurrent-channels": 16}
+        families = [
+            ("raft", {"type": "raft/baseline", "parameters": tiny}),
+            ("raft_fs", {"type": "raft/fs", "parameters": tiny}),
+            ("raft_dicl_sl", {"type": "raft+dicl/sl", "parameters": {
+                "corr-radius": 2, "corr-channels": 16,
+                "context-channels": 16, "recurrent-channels": 16}}),
+        ]
+    else:
+        h, w, batch, n_batches = 384, 704, 2, 8
+        families = [
+            ("raft", {"type": "raft/baseline",
+                      "parameters": {"mixed-precision": True}}),
+            ("raft_fs", {"type": "raft/fs",
+                         "parameters": {"mixed-precision": True}}),
+            ("raft_dicl_sl", {"type": "raft+dicl/sl",
+                              "parameters": {"mixed-precision": True}}),
+        ]
+
+    budget_s = float(os.environ.get("BENCH_LADDER_BUDGET_S", "900"))
+    t_start = time.monotonic()
+    increments = tuple(b - a for a, b in zip(rungs, rungs[1:]))
+
+    # constant-shift ground truth: a different (dy, dx) per batch so the
+    # adaptive policy sees per-batch variation
+    shifts = [(2, 3), (1, -2), (-2, 1), (3, 2), (-1, -3), (2, -1),
+              (1, 1), (-3, 2)]
+    rng = np.random.RandomState(7)
+    batches = []
+    for i in range(n_batches):
+        dy, dx = shifts[i % len(shifts)]
+        i1 = rng.rand(batch, h, w, 3).astype(np.float32)
+        i2 = np.roll(i1, (dy, dx), axis=(1, 2))
+        gt = np.zeros((batch, h, w, 2), np.float32)
+        gt[..., 0] = dx
+        gt[..., 1] = dy
+        batches.append((jnp.asarray(i1), jnp.asarray(i2), gt))
+
+    def epe(flow, gt):
+        d = np.asarray(flow, np.float32) - gt
+        return float(np.mean(np.sqrt(np.sum(d * d, axis=-1))))
+
+    result = {"metric": "ladder-frontier", "rungs": list(rungs),
+              "shape": f"{batch}x{h}x{w}", "families": {}}
+    for name, model_cfg in families:
+        elapsed = time.monotonic() - t_start
+        if result["families"] and elapsed > budget_s * 0.8:
+            result["families"][name] = {
+                "skipped": f"budget ({elapsed:.0f}s elapsed)"}
+            print(json.dumps(result), flush=True)
+            continue
+        spec = models.load({
+            "name": name, "id": f"bench-ladder-{name}",
+            "model": model_cfg, "loss": {"type": "raft/sequence"},
+            "input": {"padding": {"type": "modulo", "mode": "zeros",
+                                  "size": [8, 8]}}})
+        model = spec.model
+        variables = model.init(jax.random.PRNGKey(0), batches[0][0],
+                               batches[0][1], iterations=1)
+
+        progs = {}
+        for k in rungs:
+            progs[(k, False)] = evaluation.make_rung_fn(
+                model, k, model_id=spec.id)
+        for inc in sorted(set(increments)):
+            progs[(inc, True)] = evaluation.make_rung_fn(
+                model, inc, cont=True, model_id=spec.id)
+
+        fam = {"frontier": [], "adaptive": {}}
+
+        # fixed budgets: one program each, warmed then timed
+        base_deltas = []
+        for k in rungs:
+            step = progs[(k, False)]
+            flow, st = step(variables, *batches[0][:2])
+            jax.block_until_ready(flow)
+            times, errs = [], []
+            for i1, i2, gt in batches:
+                t0 = time.perf_counter()
+                flow, st = step(variables, i1, i2)
+                jax.block_until_ready(flow)
+                times.append(time.perf_counter() - t0)
+                errs.append(epe(flow, gt))
+                if k == rungs[0]:
+                    base_deltas.append(float(np.max(np.asarray(st["delta"]))))
+            fam["frontier"].append({
+                "iterations": k,
+                "epe": round(sum(errs) / len(errs), 4),
+                "mean_ms": round(1e3 * sum(times) / len(times), 3)})
+
+        # adaptive: threshold at an upper quantile of the base-rung
+        # deltas (see docstring — emulates the converged-model regime
+        # where only straggler batches escalate)
+        threshold = float(np.percentile(base_deltas, pctl))
+        step0 = progs[(rungs[0], False)]
+        for inc in sorted(set(increments)):
+            s = progs[(inc, True)]
+            flow, st = step0(variables, *batches[0][:2])
+            flow, st = s(variables, *batches[0][:2], st["flow"],
+                         st["hidden"])
+            jax.block_until_ready(flow)
+        times, errs, iters_run = [], [], []
+        for i1, i2, gt in batches:
+            t0 = time.perf_counter()
+            flow, st = step0(variables, i1, i2)
+            executed = rungs[0]
+            for inc in increments:
+                worst = float(np.max(np.asarray(st["delta"])))
+                if worst <= threshold:
+                    break
+                flow, st = progs[(inc, True)](variables, i1, i2,
+                                              st["flow"], st["hidden"])
+                executed += inc
+            jax.block_until_ready(flow)
+            times.append(time.perf_counter() - t0)
+            errs.append(epe(flow, gt))
+            iters_run.append(executed)
+        full = fam["frontier"][-1]
+        adaptive_ms = 1e3 * sum(times) / len(times)
+        adaptive_epe = sum(errs) / len(errs)
+        fam["adaptive"] = {
+            "threshold": round(threshold, 4),
+            "epe": round(adaptive_epe, 4),
+            "mean_ms": round(adaptive_ms, 3),
+            "mean_iterations": round(sum(iters_run) / len(iters_run), 2),
+            "vs_full": {
+                "latency_ratio": round(adaptive_ms / full["mean_ms"], 4),
+                "epe_regression": round(
+                    (adaptive_epe - full["epe"]) / max(full["epe"], 1e-9),
+                    4)},
+        }
+        result["families"][name] = fam
+        print(json.dumps(result), flush=True)
+
+
 def _bench_dicl():
     """Matching-phase breakdown (``BENCH_DICL=1``): window-sample ms (XLA
     gather vs fused Pallas sampler) and matching-net ms (per-level loop vs
@@ -1048,6 +1215,20 @@ def main():
         from raft_meets_dicl_tpu import telemetry
         telemetry.activate(telemetry.create())
         _bench_serve()
+        return
+
+    if os.environ.get("BENCH_LADDER", "0") != "0":
+        # iteration-ladder frontier: EPE vs latency at fixed recurrence
+        # budgets plus the adaptive escalation policy. Persistent cache
+        # on: program compiles are not the measurement, the per-rung
+        # execution times are.
+        from raft_meets_dicl_tpu.utils.compcache import (
+            enable_persistent_cache,
+        )
+        enable_persistent_cache()
+        from raft_meets_dicl_tpu import telemetry
+        telemetry.activate(telemetry.create())
+        _bench_ladder()
         return
 
     if os.environ.get("BENCH_DICL", "0") != "0":

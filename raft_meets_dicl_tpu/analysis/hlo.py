@@ -248,29 +248,88 @@ def build_flagship_programs(n_devices=2, shape=(48, 64), mesh2d=False):
         kind="train_step", model="raft-baseline",
         flags=programs.flag_items(shape=(b, h, w), audit=1,
                                   mesh2d=bool(partitioner)))
-    make = parallel.make_train_step(
+    train_prog = parallel.make_train_step(
         model, loss, tx, mesh=mesh, model_args=model_args,
         state_sharding=state_sharding, donate=False, key=train_key)
-    del make  # audited via the registry entry
 
+    # make_eval_step extends caller keys with the effective model args
+    # (the iterations-collision fix), so use the returned program rather
+    # than re-fetching the pre-extension key from the registry
     eval_key = programs.ProgramKey(
         kind="eval_step", model="raft-baseline",
         flags=programs.flag_items(shape=(b, h, w), audit=1))
-    parallel.make_eval_step(model, mesh=mesh, model_args=model_args,
-                            key=eval_key)
+    eval_prog = parallel.make_eval_step(model, mesh=mesh,
+                                        model_args=model_args, key=eval_key)
 
     eval_variables = jax.device_put(
         variables, parallel.partition.replicated(mesh))
 
-    reg = programs.registry()
     out = []
-    train_prog = reg.get(train_key)
     out.append((train_prog, (state, *batch),
                 {"n_devices": n_devices, "expect_gather": expect_gather}))
-    eval_prog = reg.get(eval_key)
     out.append((eval_prog, (eval_variables, batch[0], batch[1]),
                 {"n_devices": n_devices}))
     return out
+
+
+def build_ladder_programs(rungs=(2, 4, 6), shape=(48, 64), batch=1,
+                          mixed_precision=True):
+    """Register every iteration-ladder rung program of a tiny
+    mixed-precision raft model and return ``[(program, args,
+    audit_kwargs)]`` for auditing.
+
+    The ladder contract the audit pins: each rung the ladder executes —
+    base, distinct continuation increments, monolithic full budget — is
+    exactly one registered program (one ``ProgramKey`` flag variant),
+    however many latency classes or batch fill levels ride it; each
+    lowers fingerprint-stably (else every boot misses the AOT store);
+    and the bf16 policy survives into the rung graphs (no f32
+    convolutions).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from .. import evaluation, models
+    from ..serve.ladder import LadderSpec
+
+    cfg = {
+        "name": "ladder audit", "id": "ladder-audit",
+        "model": {"type": "raft/baseline",
+                  "parameters": {"corr-levels": 2, "corr-radius": 2,
+                                 "corr-channels": 32,
+                                 "context-channels": 16,
+                                 "recurrent-channels": 16,
+                                 "mixed-precision": mixed_precision}},
+        "loss": {"type": "raft/sequence"},
+        "input": {"padding": {"type": "modulo", "mode": "zeros",
+                              "size": [8, 8]}},
+    }
+    spec = models.load(cfg)
+    model = spec.model
+    h, w = shape
+    rng = np.random.RandomState(0)
+    img1 = jnp.asarray(rng.rand(batch, h, w, 3).astype(np.float32))
+    img2 = jnp.asarray(rng.rand(batch, h, w, 3).astype(np.float32))
+    variables = model.init(jax.random.PRNGKey(0), img1, img2, iterations=1)
+
+    lad = LadderSpec(rungs=rungs)
+    base = evaluation.make_rung_fn(model, lad.rungs[0], model_id=spec.id)
+    # one base execution provides correctly-shaped carries for the
+    # continuation rungs' example args
+    _, state = base(variables, img1, img2)
+
+    kwargs = {"expect_bf16": mixed_precision, "n_devices": 1}
+    entries = [(base, (variables, img1, img2), dict(kwargs))]
+    for its, cont in lad.programs():
+        if (its, cont) == (lad.rungs[0], False):
+            continue
+        prog = evaluation.make_rung_fn(model, its, cont=cont,
+                                       model_id=spec.id)
+        args = ((variables, img1, img2, state["flow"], state["hidden"])
+                if cont else (variables, img1, img2))
+        entries.append((prog, args, dict(kwargs)))
+    return entries
 
 
 def audit_registry(entries=None, **build_kwargs):

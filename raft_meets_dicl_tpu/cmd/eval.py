@@ -161,11 +161,24 @@ def evaluate(args):
     pad_to = args.batch_size if buckets is not None else None
     stats = evaluation.EvalRunStats(name="evaluate")
 
+    # recurrence-budget override: CLI --iterations > RMD_ITERATIONS >
+    # the model config's default (0/unset means no override). The
+    # program key hashes the effective merged arguments, so overridden
+    # sweeps never collide with the default program or its AOT artifact
+    from ..utils import env
+
+    iterations = getattr(args, "iterations", None)
+    if iterations is None:
+        iterations = env.get_int("RMD_ITERATIONS") or None
+    model_args = {"iterations": int(iterations)} if iterations else None
+    if iterations:
+        logging.info(f"iteration override: {iterations}")
+
     # stable model id: the program dedupes with any other builder of the
     # same (model, bucket, wire) triple in this process (e.g. a training
     # validation pass) and round-trips through the AOT store across boots
-    eval_fn = evaluation.make_eval_fn(model, None, mesh=mesh, wire=wire,
-                                      model_id=spec.id)
+    eval_fn = evaluation.make_eval_fn(model, model_args, mesh=mesh,
+                                      wire=wire, model_id=spec.id)
     if getattr(args, "precompile", False):
         if buckets is None or not buckets.sizes:
             raise ValueError(

@@ -3,13 +3,15 @@
 Boots one replica (model + warm compiled-program pool), then either:
 
 - ``--prebuild``: compile and AOT-export every (model, bucket, wire)
-  triple of the serve config and exit — the deploy-time warm-pool
-  builder (a replica booting against the exported store serves its
-  first request with zero compiles);
+  triple of the serve config — with ``--ladder``, every iteration-rung
+  program too — and exit: the deploy-time warm-pool builder (a replica
+  booting against the exported store serves its first request with zero
+  compiles);
 - default: run the built-in open-loop load generator against the
   scheduler and print the SLO report (p50/p99 latency, pairs/s,
-  shed/error counts) as JSON — the in-process serving harness the
-  network frontend will mount.
+  shed/error counts; with ``--ladder``, the per-class breakdown) as
+  JSON — the in-process serving harness the network frontend will
+  mount.
 
 Knob precedence everywhere: CLI flag > config file (``serve:`` section)
 > ``RMD_SERVE_*`` environment knob > registered default.
@@ -110,15 +112,25 @@ def serve(args):
         checkpoint = _resolve(cfg["checkpoint"],
                               getattr(args, "config", None))
 
+    ladder_spec = _pick(getattr(args, "ladder", None), cfg, "ladder", None)
+    ladder = None
+    if ladder_spec:
+        ladder = serving.LadderSpec.from_config(
+            ladder_spec, threshold=_pick(
+                getattr(args, "ladder_threshold", None), cfg,
+                "ladder-threshold", None))
+        logging.info(f"iteration ladder: {ladder.describe()}")
+
     session = serving.ServeSession(
         spec, buckets, wire=wire, checkpoint=checkpoint,
-        batch_size=batch_size)
+        batch_size=batch_size, ladder=ladder)
 
     outcomes = session.warm_pool()
     for o in outcomes:
+        rung = f" rung {o['rung']}" if "rung" in o else ""
         logging.info(
             f"warm pool: {o['model']} bucket {o['bucket']} batch "
-            f"{o['batch']} [{o['wire']}] — {o['compiles']} compiles, "
+            f"{o['batch']}{rung} [{o['wire']}] — {o['compiles']} compiles, "
             f"{o['aot_hits']} AOT hits, {o['aot_saves']} AOT saves "
             f"({o['seconds']:.2f} s)")
 
@@ -147,11 +159,13 @@ def serve(args):
 
     requests = int(_pick(args.requests, cfg, "requests", 32))
     rate = float(_pick(args.rate, cfg, "rate", 50.0))
+    classes = list(serving.CLASSES) if ladder is not None else None
     logging.info(f"open-loop load: {requests} requests at {rate}/s over "
-                 f"{len(shapes)} shapes")
+                 f"{len(shapes)} shapes"
+                 + (f", classes {'/'.join(classes)}" if classes else ""))
 
     report = serving.loadgen.run_open_loop(
-        scheduler, shapes, requests=requests, rate_hz=rate)
+        scheduler, shapes, requests=requests, rate_hz=rate, classes=classes)
     scheduler.stop(drain=True)
 
     logging.info(

@@ -214,9 +214,15 @@ def make_eval_fn(model, model_args=None, mesh=None, wire=None,
 
     # registry identity: stable when the caller names the model and every
     # policy component reprs exactly; otherwise pinned to this model
-    # object (the _refs reference keeps its id unique while cached)
+    # object (the _refs reference keeps its id unique while cached).
+    # The key hashes the model's *config-default* arguments merged under
+    # the explicit overrides — Model.apply merges them the same way at
+    # call time, so two models with the same id but different config
+    # defaults (e.g. ``iterations``) must NOT share a program/AOT
+    # artifact. Explicit-args-only keys silently collided here.
     pkey = None
-    args_key = static_args_key(model_args)
+    args_key = static_args_key(
+        dict(getattr(model, "arguments", {})) | model_args)
     if args_key is not None and variables_sharding is None:
         mesh_key = (None if mesh is None
                     else tuple(d.id for d in mesh.devices.flat))
@@ -259,6 +265,120 @@ def make_eval_fn(model, model_args=None, mesh=None, wire=None,
     # stable keys; the raw jit stays reachable via __wrapped__
     step = programs.register_step("eval_step", step, key=pkey)
     step._refs = (model,)
+
+    return _cache(step)
+
+
+def make_rung_fn(model, iterations, cont=False, mesh=None, wire=None,
+                 variables_sharding=None, model_id=None, model_args=None):
+    """Registered ladder-rung program: a fixed-``iterations`` inference
+    step that returns the continuation carry alongside the final flow.
+
+    - ``cont=False``: ``(variables, img1, img2) -> (final_flow, state)``
+      — a base rung starting from zero flow.
+    - ``cont=True``: ``(variables, img1, img2, flow, hidden) ->
+      (final_flow, state)`` — a continuation rung re-entering the
+      recurrence from a previous rung's carry (bit-exact: the models
+      carry flow, not coords, across iterations).
+
+    ``state`` is ``{"flow", "hidden", "delta"}`` — coarse-grid carry
+    arrays (left on device; hand them to the next rung unfetched) plus a
+    per-sample convergence norm the host reads *between* programs. Each
+    (iterations, cont) pair is its own ``ProgramKey`` flag variant
+    (kind ``rung_step``), so rungs dedupe process-wide, AOT-export, and
+    prefetch like any other program; ``serve --prebuild`` exports the
+    whole ladder this way.
+    """
+    from .. import compile as programs
+    from ..parallel import partition
+
+    iterations = int(iterations)
+    cont = bool(cont)
+    model_args = dict(model_args or {})
+    for reserved in ("iterations", "flow_init", "hidden_init",
+                     "return_state"):
+        model_args.pop(reserved, None)
+
+    base = _cache_key(model, model_args, mesh, wire, variables_sharding)
+    key = None if base is None else ("rung", iterations, cont) + base
+    if key is not None and key in _EVAL_FN_CACHE:
+        return _EVAL_FN_CACHE[key]
+
+    def _cache(step):
+        if key is not None:
+            while len(_EVAL_FN_CACHE) >= _EVAL_FN_CACHE_MAX:
+                _EVAL_FN_CACHE.pop(next(iter(_EVAL_FN_CACHE)))
+            _EVAL_FN_CACHE[key] = step
+        return step
+
+    # same identity contract as make_eval_fn, including the config-default
+    # argument merge (the iterations/cont flags are what distinguish the
+    # rungs of one ladder)
+    pkey = None
+    args_key = static_args_key(
+        dict(getattr(model, "arguments", {})) | model_args)
+    if args_key is not None and variables_sharding is None:
+        mesh_key = (None if mesh is None
+                    else tuple(d.id for d in mesh.devices.flat))
+        wire_key = None if wire is None else (
+            wire.images, wire.flow, wire.pack_valid, wire.clip, wire.range)
+        pkey = programs.ProgramKey(
+            kind="rung_step",
+            model=model_id or programs.unstable(model),
+            flags=programs.flag_items(
+                args=args_key, iterations=iterations, cont=cont,
+                mesh=mesh_key, wire=wire_key))
+        existing = programs.registry().get(pkey)
+        if existing is not None:
+            return _cache(existing)
+
+    adapter = model.get_adapter()
+    gather = (mesh is not None and variables_sharding is not None
+              and partition.is_sharded(variables_sharding))
+    repl_one = partition.replicated(mesh) if mesh is not None else None
+
+    forward_args = dict(model_args)
+    forward_args["iterations"] = iterations
+    forward_args["return_state"] = True
+
+    def _forward(variables, img1, img2, flow, hidden):
+        if gather:
+            variables = jax.lax.with_sharding_constraint(
+                variables, repl_one)
+        if wire is not None:
+            img1, img2, _, _ = wire.decode(img1, img2)
+        kwargs = dict(forward_args)
+        if flow is not None:
+            kwargs["flow_init"] = flow
+        if hidden is not None:
+            kwargs["hidden_init"] = hidden
+        out, state = model.apply(variables, img1, img2, train=False,
+                                 **kwargs)
+        result = adapter.wrap_result(out, img1.shape[1:3])
+        return result.final(), state
+
+    if cont:
+        def step(variables, img1, img2, flow, hidden):
+            return _forward(variables, img1, img2, flow, hidden)
+    else:
+        def step(variables, img1, img2):
+            return _forward(variables, img1, img2, None, None)
+
+    if mesh is None:
+        step = jax.jit(step)
+    else:
+        data = partition.data_sharding(mesh)
+        variables_in = (variables_sharding if variables_sharding is not None
+                        else partition.replicated(mesh))
+        shardings = (variables_in, data, data)
+        if cont:
+            shardings = shardings + (data, data)
+        step = jax.jit(step, in_shardings=shardings)
+
+    step = programs.register_step("rung_step", step, key=pkey)
+    step._refs = (model,)
+    step.iterations = iterations
+    step.cont = cont
 
     return _cache(step)
 

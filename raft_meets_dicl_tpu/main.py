@@ -138,6 +138,10 @@ def main():
     eval_.add_argument("-c", "--checkpoint", required=True, help="the checkpoint to load")
     eval_.add_argument("-b", "--batch-size", type=int, default=1,
                        help="batch-size to use for evaluation")
+    eval_.add_argument("--iterations", type=int,
+                       help="recurrence iteration override for the "
+                            "model's update loop (also: RMD_ITERATIONS) "
+                            "[default: model config]")
     eval_.add_argument("-x", "--metrics",
                        help="specification of metrics to use for evaluation")
     eval_.add_argument("-o", "--output",
@@ -225,9 +229,20 @@ def main():
                        help="per-bucket admission queue bound; overload "
                             "sheds with a typed rejection (also: "
                             "RMD_SERVE_QUEUE) [default: 64]")
+    serve.add_argument("--ladder", nargs="?", const=True, metavar="RUNGS",
+                       help="serve latency classes (fast/balanced/"
+                            "quality) over an iteration ladder; optional "
+                            "ascending rung budgets, e.g. '4,8,12' "
+                            "(also: RMD_LADDER, the config's 'ladder' "
+                            "key) [default: off]")
+    serve.add_argument("--ladder-threshold", type=float,
+                       help="flow-delta norm below which the balanced "
+                            "class stops escalating (also: "
+                            "RMD_LADDER_THRESHOLD) [default: 0.1]")
     serve.add_argument("--prebuild", action="store_true",
                        help="compile + AOT-export every (model, bucket, "
-                            "wire) program triple and exit (deploy-time "
+                            "wire) program triple — with --ladder, every "
+                            "rung program too — and exit (deploy-time "
                             "warm-pool build)")
     serve.add_argument("--requests", type=int,
                        help="built-in open-loop client: request count "

@@ -14,8 +14,22 @@ after Teed & Deng's RAFT) in Flax/JAX:
   channel 0 = x.
 
 Static switches (``iterations``, ``upnet``, ``corr_flow``,
-``corr_grad_stop``, ``mask_costs``) are python-level arguments: changing
-them recompiles, matching the per-stage argument override model.
+``corr_grad_stop``, ``mask_costs``, ``return_state``) are python-level
+arguments: changing them recompiles, matching the per-stage argument
+override model.
+
+Iteration-ladder continuation: ``flow_init``/``hidden_init`` seed the
+recurrence carry at the 1/8 grid and ``return_state=True`` returns the
+final carry alongside the flow list, so ``iterations=12`` can run as
+chained shorter programs (4+4+4) with ``(flow, hidden)`` handed between
+them — each rung recomputes the encoders/pyramid (deterministic, same
+images), and the carry re-entry is exact: the scan body's first action
+is ``flow = coords1 - coords0`` with ``coords1 = coords0 + flow_init``,
+an integer-grid add/subtract round-trip that is lossless in f32 for any
+flow magnitude a real pair produces. The returned ``delta`` (mean-pixel
+L2 of the last iteration's flow change, per sample) is the cheap
+convergence probe the serving ladder reads *between* programs — no
+data-dependent control flow ever enters the jit.
 """
 
 from typing import Any, Tuple
@@ -314,8 +328,14 @@ class Up8Network(nn.Module):
 class _RaftStep(nn.Module):
     """One GRU iteration — the nn.scan body.
 
-    Carry is (hidden, coords1); broadcast inputs are the correlation
-    pyramid, context features, and the coords0 grid. Produces the
+    Carry is (hidden, flow); broadcast inputs are the correlation
+    pyramid, context features, and the coords0 grid. The carry is the
+    *flow* (not coords1) so that a program boundary is a no-op: every
+    iteration reconstructs ``coords1 = coords0 + flow`` itself, which is
+    exactly what a continuation rung does with ``flow_init`` — chained
+    4+4+4 is therefore bit-identical to monolithic 12 in f32 (carrying
+    coords1 instead would make re-entry inexact: ``c0 + fl(c1 - c0)``
+    loses ulps once |flow| exceeds the coarse coords). Produces the
     coarse-grid flow and hidden state per iteration — the convex 8x
     upsampling runs *outside* the scan, batched over all iterations (its
     full-resolution intermediates would otherwise be rematerialized per
@@ -334,9 +354,9 @@ class _RaftStep(nn.Module):
 
     @nn.compact
     def __call__(self, carry, pyramid, x, coords0):
-        h, coords1 = carry
-        coords1 = jax.lax.stop_gradient(coords1)
-        flow = coords1 - coords0
+        h, flow = carry
+        flow = jax.lax.stop_gradient(flow)
+        coords1 = coords0 + flow
 
         # per-level list form: the flatten-to-K² + level concat the flat
         # lookup would do costs tile-padding layout copies (~30 ms/step);
@@ -370,7 +390,7 @@ class _RaftStep(nn.Module):
         coords1 = coords1 + d
         flow = coords1 - coords0
 
-        return (h, coords1), (flow, h, corr_flows)
+        return (h, flow), (flow, h, corr_flows)
 
 
 class RaftModule(nn.Module):
@@ -393,8 +413,8 @@ class RaftModule(nn.Module):
 
     @nn.compact
     def __call__(self, img1, img2, train=False, frozen_bn=False, iterations=12,
-                 flow_init=None, upnet=True, corr_flow=False,
-                 corr_grad_stop=False, mask_costs=()):
+                 flow_init=None, hidden_init=None, upnet=True, corr_flow=False,
+                 corr_grad_stop=False, mask_costs=(), return_state=False):
         hdim = self.recurrent_channels
         cdim = self.context_channels
         reg_args = self.corr_reg_args or {}
@@ -433,10 +453,15 @@ class RaftModule(nn.Module):
         ctx = cnet(img1, train, frozen_bn)
         h = jnp.tanh(ctx[..., :hdim])
         x = nn.relu(ctx[..., hdim:])
+        if hidden_init is not None:
+            # continuation rung: re-enter the recurrence with the previous
+            # program's final hidden state (the context tanh is DCE'd)
+            h = hidden_init.astype(h.dtype)
 
         b, hc, wc, _ = fmap1.shape
         coords0 = coordinate_grid(b, hc, wc)
-        coords1 = coords0 + flow_init if flow_init is not None else coords0
+        flow = (flow_init.astype(jnp.float32) if flow_init is not None
+                else jnp.zeros((b, hc, wc, 2), jnp.float32))  # graftlint: disable=f32-literal -- flow fields are f32 by convention
 
         # remat the scan body: recompute iteration activations in the
         # backward pass instead of storing 12 iterations' worth in HBM —
@@ -470,8 +495,8 @@ class RaftModule(nn.Module):
             dtype=dt,
         )
 
-        (h, coords1), (flows, hiddens, corr_flows) = step(
-            (h, coords1), pyramid, x, coords0
+        (h, flow), (flows, hiddens, corr_flows) = step(
+            (h, flow), pyramid, x, coords0
         )
 
         # convex 8x upsampling, batched over all iterations at once (one
@@ -504,7 +529,24 @@ class RaftModule(nn.Module):
                 [corr_flows[lvl][i] for i in range(iterations)]
                 for lvl in range(self.corr_levels)
             ]
-            return (*reversed(per_level), out)
+            out = (*reversed(per_level), out)
+
+        if return_state:
+            # ladder continuation carry + convergence probe: the coarse
+            # final flow/hidden re-seed the next rung; ``delta`` is the
+            # per-sample mean-pixel L2 of the last iteration's flow change
+            # — the host reads it between programs to decide "converged"
+            final = flows[-1]
+            if iterations >= 2:
+                prev = flows[-2]
+            elif flow_init is not None:
+                prev = flow_init.astype(jnp.float32)
+            else:
+                prev = jnp.zeros_like(final)
+            diff = (final - prev).astype(jnp.float32)
+            delta = jnp.sqrt(jnp.mean(jnp.sum(diff * diff, axis=-1),
+                                      axis=(1, 2)))
+            return out, {"flow": final, "hidden": h, "delta": delta}
 
         return out
 

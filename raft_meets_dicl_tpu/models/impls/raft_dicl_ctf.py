@@ -67,9 +67,12 @@ class _CtfStep(nn.Module):
     def __call__(self, carry, _, f1, f2, x, coords0):
         from jax.ad_checkpoint import checkpoint_name
 
-        h, coords1 = carry
-        coords1 = jax.lax.stop_gradient(coords1)
-        prev = coords1 - coords0
+        # flow (not coords1) carry: program boundaries replay the same
+        # ``coords0 + flow`` reconstruction, so ladder rungs chain
+        # bit-exactly (see raft._RaftStep)
+        h, prev = carry
+        prev = jax.lax.stop_gradient(prev)
+        coords1 = coords0 + prev
 
         corr = self.cmod(f1, f2, coords1, dap=self.dap, train=self.train,
                          frozen_bn=self.frozen_bn)
@@ -88,8 +91,9 @@ class _CtfStep(nn.Module):
 
         h, d = self.update(h, x, corr, prev)
         coords1 = coords1 + d
+        flow = coords1 - coords0
 
-        return (h, coords1), (coords1 - coords0, h, readout, prev)
+        return (h, flow), (flow, h, readout, prev)
 
 
 class RaftPlusDiclCtfModule(nn.Module):
@@ -140,7 +144,8 @@ class RaftPlusDiclCtfModule(nn.Module):
     @nn.compact
     def __call__(self, img1, img2, train=False, frozen_bn=False,
                  iterations=None, dap=True, upnet=True, corr_flow=False,
-                 prev_flow=False, corr_grad_stop=False):
+                 prev_flow=False, corr_grad_stop=False, flow_init=None,
+                 hidden_init=None, return_state=False):
         hdim = self.recurrent_channels
         cdim = self.context_channels
         b, h, w = img1.shape[0], img1.shape[1], img1.shape[2]
@@ -163,7 +168,23 @@ class RaftPlusDiclCtfModule(nn.Module):
         enc_kw = {"dtype": dt} if dt is not None else {}
         ctx_kw = {"dtype": dt} if dt is not None else {}
 
-        iterations = tuple(iterations or _DEFAULT_ITERATIONS[self.levels])
+        # ladder continuation: with ``hidden_init`` only the finest (1/8)
+        # level runs, re-entering its recurrence from the previous rung's
+        # ``(flow, hidden)``; an int ``iterations`` means the finest-level
+        # count (coarse levels keep their defaults — a continuation never
+        # re-runs them, so chained rungs match one longer finest loop)
+        cont = hidden_init is not None
+        if flow_init is not None and not cont:
+            raise ValueError(
+                "ctf models take flow_init only together with hidden_init "
+                "(a continuation rung at the finest level); the coarse "
+                "pyramid has no seeding protocol")
+        if isinstance(iterations, int):
+            its = list(_DEFAULT_ITERATIONS[self.levels])
+            its[-1] = iterations
+            iterations = tuple(its)
+        else:
+            iterations = tuple(iterations or _DEFAULT_ITERATIONS[self.levels])
         assert len(iterations) == self.levels
 
         # level ids coarse→fine, e.g. (5, 4, 3) for 3 levels; level L = 1/2^L
@@ -222,25 +243,35 @@ class RaftPlusDiclCtfModule(nn.Module):
         h_state = None
 
         for li, lvl in enumerate(level_ids):
+            finest = li == self.levels - 1
+            if cont and not finest:
+                continue
+
             scale = 2 ** lvl
             lh, lw = h // scale, w // scale
             fine_idx = lvl - 3  # index into finest-first feature tuples
             n_iter = iterations[li]
 
             coords0 = coordinate_grid(b, lh, lw)
-            if flow is None:
-                coords1 = coords0
+            if cont:
+                flow = (flow_init.astype(jnp.float32)
+                        if flow_init is not None
+                        else jnp.zeros((b, lh, lw, 2), jnp.float32))  # graftlint: disable=f32-literal -- flow fields are f32 by convention
+                h_state = hidden_init.astype(hidden[fine_idx].dtype)
             else:
-                flow = upsample_flow_2x(flow)
-                coords1 = coords0 + flow
+                if flow is None:
+                    flow = jnp.zeros((b, lh, lw, 2), jnp.float32)  # graftlint: disable=f32-literal -- flow fields are f32 by convention
+                else:
+                    flow = upsample_flow_2x(flow)
 
-            if h_state is None:
-                h_state = hidden[fine_idx]
-            else:
-                h_state = hups[lvl](h_state, hidden[fine_idx])
+                if h_state is None:
+                    h_state = hidden[fine_idx]
+                else:
+                    h_state = hups[lvl](h_state, hidden[fine_idx])
+            if finest:
+                entry_flow = flow
 
             x = context[fine_idx]
-            finest = li == self.levels - 1
 
             # one (remat-wrapped) step body serves both realizations:
             # iterations share spatial shapes within a level, and remat
@@ -265,7 +296,7 @@ class RaftPlusDiclCtfModule(nn.Module):
                 # python loop over the same step module — sequential
                 # batch-stat updates, identical parameter paths
                 step = body(**shared)
-                carry = (h_state, coords1)
+                carry = (h_state, flow)
                 flows, hiddens, readouts, prevs = [], [], [], []
                 for _ in range(n_iter):
                     carry, (fl, hi, ro, pv) = step(
@@ -276,7 +307,7 @@ class RaftPlusDiclCtfModule(nn.Module):
                     hiddens.append(hi)
                     readouts.append(ro)
                     prevs.append(pv)
-                h_state, coords1 = carry
+                h_state, flow = carry
 
                 flows = jnp.stack(flows)
                 hiddens = jnp.stack(hiddens)
@@ -292,8 +323,8 @@ class RaftPlusDiclCtfModule(nn.Module):
                     out_axes=0,
                 )(**shared)
 
-                (h_state, coords1), (flows, hiddens, readouts, prevs) = step(
-                    (h_state, coords1), jnp.zeros((n_iter, 0), dtype=jnp.bfloat16),
+                (h_state, flow), (flows, hiddens, readouts, prevs) = step(
+                    (h_state, flow), jnp.zeros((n_iter, 0), dtype=jnp.bfloat16),
                     f1[fine_idx], f2[fine_idx], x, coords0,
                 )
 
@@ -325,6 +356,18 @@ class RaftPlusDiclCtfModule(nn.Module):
             if corr_flow:
                 out.append(out_corr)
             out.append(out_lvl)
+
+        if return_state:
+            # finest-level (1/8) carry + convergence probe, as in raft
+            final = flows[-1]
+            if iterations[-1] >= 2:
+                prev_f = flows[-2]
+            else:
+                prev_f = entry_flow
+            diff = (final - prev_f).astype(jnp.float32)
+            delta = jnp.sqrt(jnp.mean(jnp.sum(diff * diff, axis=-1),
+                                      axis=(1, 2)))
+            return out, {"flow": final, "hidden": h_state, "delta": delta}
 
         return out
 

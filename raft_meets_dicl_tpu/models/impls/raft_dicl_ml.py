@@ -266,9 +266,12 @@ class _MlStep(nn.Module):
     def __call__(self, carry, _, fmap1, fmap2, x, coords0):
         from jax.ad_checkpoint import checkpoint_name
 
-        h, coords1 = carry
-        coords1 = jax.lax.stop_gradient(coords1)
-        flow = coords1 - coords0
+        # flow (not coords1) carry: program boundaries replay the same
+        # ``coords0 + flow`` reconstruction, so ladder rungs chain
+        # bit-exactly (see raft._RaftStep)
+        h, flow = carry
+        flow = jax.lax.stop_gradient(flow)
+        coords1 = coords0 + flow
 
         corr = self.cvol(fmap1, fmap2, coords1, dap=self.dap,
                          mask_costs=self.mask_costs, train=self.train,
@@ -282,8 +285,9 @@ class _MlStep(nn.Module):
 
         h, d = self.update(h, x, corr, flow)
         coords1 = coords1 + d
+        flow = coords1 - coords0
 
-        return (h, coords1), (coords1 - coords0, h, corr_flows)
+        return (h, flow), (flow, h, corr_flows)
 
 
 class RaftPlusDiclMlModule(nn.Module):
@@ -311,7 +315,8 @@ class RaftPlusDiclMlModule(nn.Module):
     @nn.compact
     def __call__(self, img1, img2, train=False, frozen_bn=False, iterations=12,
                  dap=True, upnet=True, corr_flow=False, corr_grad_stop=False,
-                 flow_init=None, mask_costs=()):
+                 flow_init=None, hidden_init=None, mask_costs=(),
+                 return_state=False):
         hdim = self.recurrent_channels
         cdim = self.context_channels
         dt = jnp.bfloat16 if self.mixed_precision else None
@@ -353,10 +358,13 @@ class RaftPlusDiclMlModule(nn.Module):
         ctx = cnet(img1, train, frozen_bn)
         h = jnp.tanh(ctx[..., :hdim])
         x = nn.relu(ctx[..., hdim:])
+        if hidden_init is not None:
+            h = hidden_init.astype(h.dtype)
 
         b, hc, wc, _ = fmap1[0].shape
         coords0 = coordinate_grid(b, hc, wc)
-        coords1 = coords0 + flow_init if flow_init is not None else coords0
+        flow = (flow_init.astype(jnp.float32) if flow_init is not None
+                else jnp.zeros((b, hc, wc, 2), jnp.float32))  # graftlint: disable=f32-literal -- flow fields are f32 by convention
 
         # the matching nets follow the model's mixed policy (the reference
         # autocast covers them too; cost volumes come back f32 regardless)
@@ -393,7 +401,7 @@ class RaftPlusDiclMlModule(nn.Module):
 
         if self.unroll:
             step = body(**shared)
-            carry = (h, coords1)
+            carry = (h, flow)
             flows, hiddens, corr_flows = [], [], []
             for _ in range(iterations):
                 carry, (fl, hi, cf) = step(
@@ -401,7 +409,7 @@ class RaftPlusDiclMlModule(nn.Module):
                 flows.append(fl)
                 hiddens.append(hi)
                 corr_flows.append(cf)
-            h, coords1 = carry
+            h, flow = carry
 
             flows = jnp.stack(flows)
             hiddens = jnp.stack(hiddens)
@@ -429,8 +437,8 @@ class RaftPlusDiclMlModule(nn.Module):
                 out_axes=0,
             )(**shared)
 
-            (h, coords1), (flows, hiddens, corr_flows) = step(
-                (h, coords1), jnp.zeros((iterations, 0), dtype=jnp.bfloat16),
+            (h, flow), (flows, hiddens, corr_flows) = step(
+                (h, flow), jnp.zeros((iterations, 0), dtype=jnp.bfloat16),
                 fmap1, fmap2, x, coords0,
             )
 
@@ -451,7 +459,21 @@ class RaftPlusDiclMlModule(nn.Module):
                 [corr_flows[lvl][i] for i in range(iterations)]
                 for lvl in range(self.corr_levels)
             ]
-            return [*reversed(out_corr), out]  # coarse-to-fine, then final
+            out = [*reversed(out_corr), out]  # coarse-to-fine, then final
+
+        if return_state:
+            final = flows[-1]
+            if iterations >= 2:
+                prev = flows[-2]
+            elif flow_init is not None:
+                prev = flow_init.astype(jnp.float32)
+            else:
+                prev = jnp.zeros_like(final)
+            diff = (final - prev).astype(jnp.float32)
+            delta = jnp.sqrt(jnp.mean(jnp.sum(diff * diff, axis=-1),
+                                      axis=(1, 2)))
+            return out, {"flow": final, "hidden": h, "delta": delta}
+
         return out
 
 

@@ -54,7 +54,7 @@ class RaftPlusDiclModule(nn.Module):
     @nn.compact
     def __call__(self, img1, img2, train=False, frozen_bn=False, iterations=12,
                  dap=True, upnet=True, corr_flow=False, corr_grad_stop=False,
-                 flow_init=None):
+                 flow_init=None, hidden_init=None, return_state=False):
         hdim = self.recurrent_channels
         cdim = self.context_channels
         dt = jnp.bfloat16 if self.mixed_precision else None
@@ -75,10 +75,13 @@ class RaftPlusDiclModule(nn.Module):
         ctx = cnet(img1, train, frozen_bn)
         h = jnp.tanh(ctx[..., :hdim])
         x = nn.relu(ctx[..., hdim:])
+        if hidden_init is not None:
+            h = hidden_init.astype(h.dtype)
 
         b, hc, wc, _ = fmap1.shape
         coords0 = coordinate_grid(b, hc, wc)
-        coords1 = coords0 + flow_init if flow_init is not None else coords0
+        flow = (flow_init.astype(jnp.float32) if flow_init is not None
+                else jnp.zeros((b, hc, wc, 2), jnp.float32))  # graftlint: disable=f32-literal -- flow fields are f32 by convention
 
         corr_args = dict(self.corr_args or {})
         # matching nets follow the mixed policy (cost comes back f32);
@@ -119,7 +122,7 @@ class RaftPlusDiclModule(nn.Module):
 
         if self.unroll or (train and not frozen_bn):
             step = body(**shared)
-            carry = (h, coords1)
+            carry = (h, flow)
             flows, hiddens, readouts = [], [], []
             for _ in range(iterations):
                 carry, (fl, hi, ro, _pv) = step(
@@ -127,7 +130,7 @@ class RaftPlusDiclModule(nn.Module):
                 flows.append(fl)
                 hiddens.append(hi)
                 readouts.append(ro)
-            h, coords1 = carry
+            h, flow = carry
 
             flows = jnp.stack(flows)
             hiddens = jnp.stack(hiddens)
@@ -142,8 +145,8 @@ class RaftPlusDiclModule(nn.Module):
                 out_axes=0,
             )(**shared)
 
-            (h, coords1), (flows, hiddens, readouts, _prevs) = step(
-                (h, coords1), jnp.zeros((iterations, 0), dtype=jnp.bfloat16),
+            (h, flow), (flows, hiddens, readouts, _prevs) = step(
+                (h, flow), jnp.zeros((iterations, 0), dtype=jnp.bfloat16),
                 fmap1, fmap2, x, coords0,
             )
 
@@ -160,7 +163,20 @@ class RaftPlusDiclModule(nn.Module):
         out = [ups[i] for i in range(iterations)]
 
         if corr_flow:
-            return [[readouts[i] for i in range(iterations)], out]
+            out = [[readouts[i] for i in range(iterations)], out]
+
+        if return_state:
+            final = flows[-1]
+            if iterations >= 2:
+                prev = flows[-2]
+            elif flow_init is not None:
+                prev = flow_init.astype(jnp.float32)
+            else:
+                prev = jnp.zeros_like(final)
+            diff = (final - prev).astype(jnp.float32)
+            delta = jnp.sqrt(jnp.mean(jnp.sum(diff * diff, axis=-1),
+                                      axis=(1, 2)))
+            return out, {"flow": final, "hidden": h, "delta": delta}
 
         return out
 

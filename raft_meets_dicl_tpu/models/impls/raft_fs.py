@@ -69,7 +69,11 @@ def volume_level_split(coarse_shape, corr_levels, itemsize, budget_gib=None):
 
 
 class _FsStep(nn.Module):
-    """One GRU iteration — nn.scan body; carry is (hidden, coords1).
+    """One GRU iteration — nn.scan body; carry is (hidden, flow).
+
+    The carry is the flow (reconstructing ``coords1 = coords0 + flow``
+    every iteration) so a ladder-rung boundary reproduces the monolithic
+    program bit-exactly — see ``raft._RaftStep``.
 
     ``n_windowed`` is the per-level dispatch split: pyramid levels
     ``[0, n_windowed)`` are computed on the fly by the windowed kernel
@@ -88,9 +92,9 @@ class _FsStep(nn.Module):
 
     @nn.compact
     def __call__(self, carry, fmap1, pyramid, x, coords0):
-        h, coords1 = carry
-        coords1 = jax.lax.stop_gradient(coords1)
-        flow = coords1 - coords0
+        h, flow = carry
+        flow = jax.lax.stop_gradient(flow)
+        coords1 = coords0 + flow
 
         n_win = self.n_windowed
         if n_win == 0:
@@ -145,7 +149,7 @@ class _FsStep(nn.Module):
         coords1 = coords1 + d
         flow = coords1 - coords0
 
-        return (h, coords1), (flow, h)
+        return (h, flow), (flow, h)
 
 
 class RaftFsModule(nn.Module):
@@ -164,7 +168,8 @@ class RaftFsModule(nn.Module):
 
     @nn.compact
     def __call__(self, img1, img2, train=False, frozen_bn=False,
-                 iterations=12, flow_init=None, upnet=True, mask_costs=()):
+                 iterations=12, flow_init=None, hidden_init=None, upnet=True,
+                 mask_costs=(), return_state=False):
         hdim = self.recurrent_channels
         cdim = self.context_channels
         dt = jnp.bfloat16 if self.mixed_precision else None
@@ -220,10 +225,13 @@ class RaftFsModule(nn.Module):
         ctx = cnet(img1, train, frozen_bn)
         h = jnp.tanh(ctx[..., :hdim])
         x = nn.relu(ctx[..., hdim:])
+        if hidden_init is not None:
+            h = hidden_init.astype(h.dtype)
 
         b, hc, wc, _ = fmap1.shape
         coords0 = coordinate_grid(b, hc, wc)
-        coords1 = coords0 + flow_init if flow_init is not None else coords0
+        flow = (flow_init.astype(jnp.float32) if flow_init is not None
+                else jnp.zeros((b, hc, wc, 2), jnp.float32))  # graftlint: disable=f32-literal -- flow fields are f32 by convention
 
         # same remat policy as raft/baseline: save the correlation lookup
         # outputs (recomputing the windowed kernel / lookup einsums in the
@@ -253,8 +261,8 @@ class RaftFsModule(nn.Module):
             dtype=dt,
         )
 
-        (h, coords1), (flows, hiddens) = step((h, coords1), fmap1,
-                                              tuple(pyramid), x, coords0)
+        (h, flow), (flows, hiddens) = step((h, flow), fmap1,
+                                           tuple(pyramid), x, coords0)
 
         # convex 8x upsampling hoisted out of the remat'd scan and batched
         # over all iterations, exactly like raft/baseline (raft.py): inside
@@ -276,7 +284,22 @@ class RaftFsModule(nn.Module):
             flows_up = 8.0 * interpolate_bilinear(flows_flat, full_shape)
         flows_up = flows_up.reshape(iterations, b, *full_shape, 2)
 
-        return [flows_up[i] for i in range(iterations)]
+        out = [flows_up[i] for i in range(iterations)]
+
+        if return_state:
+            final = flows[-1]
+            if iterations >= 2:
+                prev = flows[-2]
+            elif flow_init is not None:
+                prev = flow_init.astype(jnp.float32)
+            else:
+                prev = jnp.zeros_like(final)
+            diff = (final - prev).astype(jnp.float32)
+            delta = jnp.sqrt(jnp.mean(jnp.sum(diff * diff, axis=-1),
+                                      axis=(1, 2)))
+            return out, {"flow": final, "hidden": h, "delta": delta}
+
+        return out
 
 
 @register_model

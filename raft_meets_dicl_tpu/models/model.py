@@ -101,6 +101,15 @@ class Model:
         stochastic layers (dropout), ``frozen_bn`` only switches batch norm
         to running statistics, matching the reference's selective
         ``freeze_batchnorm`` (src/models/common/norm.py:18-32).
+
+        Ladder continuation protocol: every impl accepts ``flow_init`` and
+        ``hidden_init`` (traced arrays seeding the recurrence carry at the
+        coarse grid) and a static ``return_state`` switch. With
+        ``return_state=True`` the raw output becomes ``(output, state)``
+        where ``state`` is ``{"flow", "hidden", "delta"}`` — the carry to
+        hand to the next rung program plus a per-sample convergence norm.
+        The tuple passes through here untouched; rung programs
+        (``evaluation.make_rung_fn``) unpack it themselves.
         """
         args = self.arguments | kwargs
         frozen = self.frozen_batchnorm

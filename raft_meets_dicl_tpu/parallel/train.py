@@ -330,6 +330,23 @@ def make_eval_step(model, mesh=None, model_args=None, wire=None,
     """
     model_args = dict(model_args or {})
 
+    # a caller-provided key must encode the *effective* model arguments
+    # (config defaults merged under explicit overrides, exactly how
+    # Model.apply resolves them): without this, e.g. a non-default
+    # ``iterations`` count silently shares the default program's key —
+    # and its AOT artifact — with the default-count model
+    if key is not None and not any(n == "args" for n, _ in key.flags):
+        from ..compile import ProgramKey, flag_items
+        from ..evaluation import static_args_key
+
+        args_key = static_args_key(
+            dict(getattr(model, "arguments", {})) | model_args)
+        if args_key is None:
+            key = None  # unkeyable (array-valued) args: never dedupe
+        else:
+            key = ProgramKey(kind=key.kind, model=key.model,
+                             flags=key.flags + flag_items(args=args_key))
+
     gather = (mesh is not None and variables_sharding is not None
               and partition.is_sharded(variables_sharding))
     repl_one = partition.replicated(mesh) if mesh is not None else None

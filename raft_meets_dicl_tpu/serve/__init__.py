@@ -14,17 +14,20 @@ scheduler with bounded-queue admission control:
   program, and the warm pool of precompiled executables per
   (model, bucket, wire) triple;
 - :mod:`.loadgen` — the open-loop synthetic load generator behind
-  ``BENCH_SERVE=1`` and the ``serve`` CLI's built-in client.
+  ``BENCH_SERVE=1`` and the ``serve`` CLI's built-in client;
+- :mod:`.ladder` — iteration-ladder latency classes (PR 11): adaptive
+  recurrence budgets over chained fixed-``iterations`` rung programs.
 """
 
-from . import batcher, loadgen, scheduler, session
+from . import batcher, ladder, loadgen, scheduler, session
 from .batcher import (BucketBatcher, FlowRequest, FlowResult, ServeError,
                       ServeRejected)
+from .ladder import CLASSES, LadderSpec
 from .scheduler import Scheduler, Ticket
 from .session import ServeSession
 
 __all__ = [
-    "batcher", "loadgen", "scheduler", "session",
-    "BucketBatcher", "FlowRequest", "FlowResult", "ServeError",
-    "ServeRejected", "Scheduler", "Ticket", "ServeSession",
+    "batcher", "ladder", "loadgen", "scheduler", "session",
+    "BucketBatcher", "CLASSES", "FlowRequest", "FlowResult", "LadderSpec",
+    "ServeError", "ServeRejected", "Scheduler", "Ticket", "ServeSession",
 ]

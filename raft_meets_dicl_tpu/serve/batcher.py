@@ -77,6 +77,7 @@ class FlowRequest:
     ticket: Any
     t_submit: float
     t_enqueue: float = 0.0
+    klass: str = ""  # latency class ("" = plain eval, no ladder)
     spans: Dict[str, float] = field(default_factory=dict)
 
 
@@ -94,19 +95,28 @@ class FlowResult:
     shape: Tuple[int, int]
     flow: np.ndarray
     spans: Dict[str, float]
+    klass: str = ""
+    iterations: int = 0  # recurrence iterations actually executed
 
 
 class BucketBatcher:
-    """Bounded per-bucket FIFO queues + deterministic batch selection.
+    """Bounded per-lane FIFO queues + deterministic batch selection.
+
+    A lane is ``(bucket, klass)`` — requests only coalesce with
+    same-bucket, same-latency-class neighbors, so every dispatched batch
+    runs one ladder policy end to end. Without a ladder every request
+    carries the empty class and lanes degenerate to plain per-bucket
+    queues.
 
     Selection policy (documented because tests pin it): full batches
-    first — among buckets holding at least ``batch_size`` requests, the
+    first — among lanes holding at least ``batch_size`` requests, the
     one whose head request enqueued earliest wins (ties broken by bucket
-    size, ascending). With no full batch, the oldest head whose wait
+    size then class). With no full batch, the oldest head whose wait
     exceeded the caller's deadline dispatches as a partial. Within a
-    bucket, order is strict FIFO. Everything keys on the monotonic
-    enqueue stamp plus the bucket tuple, so the same submission sequence
-    always coalesces identically.
+    lane, order is strict FIFO. Everything keys on the monotonic
+    enqueue stamp plus the lane tuple, so the same submission sequence
+    always coalesces identically. ``take`` returns the *bucket* (the
+    compiled-program shape); the batch's class rides on its requests.
     """
 
     def __init__(self, buckets, batch_size, queue_limit):
@@ -117,7 +127,7 @@ class BucketBatcher:
         self.buckets = buckets
         self.batch_size = int(batch_size)
         self.queue_limit = int(queue_limit)
-        self._queues = {b: deque() for b in buckets.sizes}
+        self._queues = {(b, ""): deque() for b in buckets.sizes}
 
     def assign(self, h, w) -> Optional[Tuple[int, int]]:
         """Smallest bucket fitting (h, w), or None (oversized)."""
@@ -130,8 +140,9 @@ class BucketBatcher:
         return encode(img1), encode(img2)
 
     def offer(self, request) -> bool:
-        """Enqueue, or refuse (bucket queue at bound — backpressure)."""
-        q = self._queues[request.bucket]
+        """Enqueue, or refuse (lane queue at bound — backpressure)."""
+        lane = (request.bucket, getattr(request, "klass", ""))
+        q = self._queues.setdefault(lane, deque())
         if len(q) >= self.queue_limit:
             return False
         request.t_enqueue = time.perf_counter()
@@ -150,22 +161,23 @@ class BucketBatcher:
         (None when every queue is empty). ``drain`` dispatches partials
         immediately (shutdown flush).
         """
-        full = [(q[0].t_enqueue, b) for b, q in self._queues.items()
+        full = [(q[0].t_enqueue, lane) for lane, q in self._queues.items()
                 if len(q) >= self.batch_size]
         if full:
-            _, bucket = min(full)
-            return bucket, self._pop(bucket)
+            _, lane = min(full)
+            return lane[0], self._pop(lane)
 
-        heads = [(q[0].t_enqueue, b) for b, q in self._queues.items() if q]
+        heads = [(q[0].t_enqueue, lane)
+                 for lane, q in self._queues.items() if q]
         if not heads:
             return None, None
-        t_head, bucket = min(heads)
+        t_head, lane = min(heads)
         if drain or now - t_head >= max_wait_s:
-            return bucket, self._pop(bucket)
+            return lane[0], self._pop(lane)
         return None, t_head + max_wait_s
 
-    def _pop(self, bucket):
-        q = self._queues[bucket]
+    def _pop(self, lane):
+        q = self._queues[lane]
         return [q.popleft() for _ in range(min(len(q), self.batch_size))]
 
     def assemble(self, requests):

@@ -100,15 +100,20 @@ class Scheduler:
 
     # -- admission (caller threads) -----------------------------------------
 
-    def submit(self, img1, img2, client="default"):
+    def submit(self, img1, img2, client="default", klass=None):
         """Admit one raw (un-normalized f32 HWC) image pair.
 
+        ``klass`` picks the latency class (``ladder.CLASSES``) when the
+        session serves an iteration ladder — defaulting to ``balanced``;
+        requests only batch with same-class neighbors. Without a ladder
+        the class must stay unset.
+
         Returns a :class:`Ticket` on acceptance. Raises synchronously:
-        :class:`ServeError` (``malformed``/``oversized``) when the
-        payload can never be served, :class:`ServeRejected`
-        (``queue_full``/``shutdown``) when the system sheds it —
-        admission is where backpressure surfaces, the dispatch loop never
-        blocks on overload.
+        :class:`ServeError` (``malformed``/``oversized``/
+        ``unknown_class``) when the payload can never be served,
+        :class:`ServeRejected` (``queue_full``/``shutdown``) when the
+        system sheds it — admission is where backpressure surfaces, the
+        dispatch loop never blocks on overload.
         """
         t0 = time.perf_counter()
         with self._lock:
@@ -116,6 +121,7 @@ class Scheduler:
             self._rid += 1
 
         try:
+            klass = self._validate_klass(klass)
             self._validate(rid, img1, img2)
             h, w = int(img1.shape[0]), int(img1.shape[1])
             bucket = self.batcher.assign(h, w)
@@ -135,7 +141,7 @@ class Scheduler:
         ticket = Ticket(rid, client)
         req = FlowRequest(rid=rid, client=client, seq=0, bucket=bucket,
                           shape=(h, w), img1=e1, img2=e2, ticket=ticket,
-                          t_submit=t0)
+                          t_submit=t0, klass=klass)
 
         with self._cond:
             if self._stopping:
@@ -155,6 +161,23 @@ class Scheduler:
             self._seq[client] = req.seq + 1
             self._cond.notify()
         return ticket
+
+    def _validate_klass(self, klass):
+        from . import ladder as ladder_mod
+
+        has_ladder = getattr(self.session, "ladder", None) is not None
+        if klass is None:
+            return "balanced" if has_ladder else ""
+        if not has_ladder:
+            raise ServeError(
+                "unknown_class",
+                f"latency class {klass!r} needs a session with an "
+                f"iteration ladder (serve --ladder)")
+        if klass not in ladder_mod.CLASSES:
+            raise ServeError(
+                "unknown_class",
+                f"{klass!r} is not one of {'/'.join(ladder_mod.CLASSES)}")
+        return klass
 
     def _validate(self, rid, img1, img2):
         if faults.fire("serve_malformed", index=rid):
@@ -247,17 +270,24 @@ class Scheduler:
             r.spans["queue"] = t0 - r.t_enqueue
 
         img1, img2, fill = self.batcher.assemble(live)
+        klass = live[0].klass  # lanes are same-class by construction
         c0 = self.session.compiles()
-        flow = self.session.run(img1, img2)
+        if klass:
+            flow, info = self.session.run_ladder(img1, img2, klass)
+        else:
+            flow, info = self.session.run(img1, img2), None
         t1 = time.perf_counter()
         flow = self.session.fetch(flow)
         t2 = time.perf_counter()
 
-        telemetry.get().emit(
-            "serve", event="batch", bucket=f"{bucket[0]}x{bucket[1]}",
-            size=len(live), fill=fill,
+        batch_event = dict(
+            bucket=f"{bucket[0]}x{bucket[1]}", size=len(live), fill=fill,
             compiles=self.session.compiles() - c0,
             seconds=round(t1 - t0, 6))
+        if info is not None:
+            batch_event.update(klass=klass, rungs=info["rungs"],
+                               iterations=info["iterations"])
+        telemetry.get().emit("serve", event="batch", **batch_event)
 
         for i, r in enumerate(live):
             h, w = r.shape
@@ -265,7 +295,8 @@ class Scheduler:
             r.spans["device"] = t2 - t1
             self._complete(r, result=FlowResult(
                 rid=r.rid, client=r.client, bucket=bucket, shape=r.shape,
-                flow=flow[i, :h, :w, :], spans=r.spans))
+                flow=flow[i, :h, :w, :], spans=r.spans, klass=klass,
+                iterations=(info["iterations"] if info else 0)))
 
     # -- completion / sticky per-client release ------------------------------
 
@@ -284,11 +315,14 @@ class Scheduler:
             tele = telemetry.get()
             if err is None:
                 res.spans["total"] = total
+                extra = ({"klass": res.klass, "iterations": res.iterations}
+                         if res.klass else {})
                 tele.emit(
                     "serve", event="request", rid=r.rid, client=r.client,
                     bucket=f"{r.bucket[0]}x{r.bucket[1]}",
                     seconds=round(total, 6),
-                    spans={k: round(v, 6) for k, v in res.spans.items()})
+                    spans={k: round(v, 6) for k, v in res.spans.items()},
+                    **extra)
             else:
                 tele.emit("serve", event="error", rid=r.rid,
                           client=r.client,

@@ -33,7 +33,7 @@ class ServeSession:
     """
 
     def __init__(self, spec, buckets, wire=None, checkpoint=None,
-                 batch_size=4, mesh=None):
+                 batch_size=4, mesh=None, ladder=None):
         buckets = ShapeBuckets.from_config(buckets) \
             if not isinstance(buckets, ShapeBuckets) else buckets
         if buckets is None or not buckets.sizes:
@@ -57,6 +57,18 @@ class ServeSession:
         self.variables = self._init_variables(checkpoint)
         self.eval_fn = evaluation.make_eval_fn(
             self.model, None, mesh=mesh, wire=wire, model_id=spec.id)
+
+        # iteration ladder (ladder.LadderSpec): one registered rung
+        # program per (iterations, cont) — base rung, continuation
+        # increments, monolithic full budget — all ProgramKey flag
+        # variants that dedupe/AOT like the plain eval program
+        self.ladder = ladder
+        self._rung_fns = {}
+        if ladder is not None:
+            for its, cont in ladder.programs():
+                self._rung_fns[(its, cont)] = evaluation.make_rung_fn(
+                    self.model, its, cont=cont, mesh=mesh, wire=wire,
+                    model_id=spec.id)
 
     @classmethod
     def from_config(cls, model_cfg, buckets, **kwargs):
@@ -115,6 +127,42 @@ class ServeSession:
         jax.block_until_ready(flow)  # graftlint: disable=host-sync -- serving dispatch-span boundary
         return flow
 
+    def run_ladder(self, img1, img2, klass):
+        """One batch through the ladder policy for ``klass``; returns
+        ``(flow, info)`` — the final flow as a ready device array plus
+        ``{"rungs", "iterations"}`` accounting.
+
+        ``fast`` and ``quality`` are single programs (base rung /
+        monolithic full budget). ``balanced`` chains continuation rungs:
+        the ``(flow, hidden)`` carry stays on device between programs,
+        only the per-sample ``delta`` norm crosses to the host — the
+        decision point that makes escalation recompile-free.
+        """
+        import jax
+
+        lad = self.ladder
+        if klass == "quality":
+            flow, _ = self._rung_fns[(lad.rungs[-1], False)](
+                self.variables, img1, img2)
+            jax.block_until_ready(flow)  # graftlint: disable=host-sync -- serving dispatch-span boundary
+            return flow, {"rungs": 1, "iterations": lad.rungs[-1]}
+
+        flow, state = self._rung_fns[(lad.rungs[0], False)](
+            self.variables, img1, img2)
+        executed, rungs = lad.rungs[0], 1
+        if klass == "balanced":
+            for inc in lad.increments():
+                worst = float(np.max(np.asarray(state["delta"])))  # graftlint: disable=host-sync -- rung decision point: the host reads the convergence norm between programs
+                if worst <= lad.threshold:
+                    break
+                flow, state = self._rung_fns[(inc, True)](
+                    self.variables, img1, img2,
+                    state["flow"], state["hidden"])
+                executed += inc
+                rungs += 1
+        jax.block_until_ready(flow)  # graftlint: disable=host-sync -- serving dispatch-span boundary
+        return flow, {"rungs": rungs, "iterations": executed}
+
     def fetch(self, flow):
         """Device flow → host numpy (the per-request ``device`` span)."""
         import jax
@@ -122,46 +170,84 @@ class ServeSession:
         return np.asarray(jax.device_get(flow))  # graftlint: disable=host-sync -- response must materialize on host
 
     def compiles(self):
-        """Exact backend-compile count of the serve program (registry
-        Program counter; see evaluation._program_compile_counter)."""
-        return getattr(self.eval_fn, "compiles", 0)
+        """Exact backend-compile count across the serve programs — the
+        eval program plus every ladder rung (registry Program counters;
+        see evaluation._program_compile_counter)."""
+        progs = [self.eval_fn, *self._rung_fns.values()]
+        return sum(getattr(p, "compiles", 0) for p in progs)
 
     # -- warm pool ------------------------------------------------------------
 
     def warm_pool(self):
         """Compile (or AOT-load) the program for every bucket at the
         serve batch size; returns one outcome record per (model, bucket,
-        wire) triple: compiles / AOT hits / AOT saves / seconds.
+        wire) triple — plus, with a ladder, one per (model, bucket,
+        wire, rung): compiles / AOT hits / AOT saves / seconds.
 
-        With a populated AOT store every triple reports ``compiles=0,
+        With a populated AOT store every record reports ``compiles=0,
         aot_hits=1``; a prebuild run (``serve --prebuild``) reports the
         saves it exported.
         """
         import jax
         import jax.numpy as jnp
 
-        step = self.eval_fn
         dtype = self.image_dtype()
         outcomes = []
-        for h, w in self.buckets.sizes:
-            t0 = time.perf_counter()
-            c0 = self.compiles()
-            h0 = getattr(step, "aot_hits", 0)
-            s0 = getattr(step, "aot_saves", 0)
-            img = jnp.zeros((self.batch_size, h, w, 3), dtype)
-            _, flow = step(self.variables, img, img)
-            jax.block_until_ready(flow)  # graftlint: disable=host-sync -- warm pool must finish before serving starts
+
+        def _counts(step):
+            return (time.perf_counter(), getattr(step, "compiles", 0),
+                    getattr(step, "aot_hits", 0),
+                    getattr(step, "aot_saves", 0))
+
+        def _record(step, bucket, rung, t0, c0, h0, s0):
             outcome = {
                 "model": self.spec.id,
-                "bucket": f"{h}x{w}",
+                "bucket": bucket,
                 "wire": (self.wire.describe() if self.wire is not None
                          else "f32 host-normalized"),
                 "batch": self.batch_size,
-                "compiles": self.compiles() - c0,
+                "compiles": getattr(step, "compiles", 0) - c0,
                 "aot_hits": getattr(step, "aot_hits", 0) - h0,
                 "aot_saves": getattr(step, "aot_saves", 0) - s0,
                 "seconds": round(time.perf_counter() - t0, 4),
             }
+            if rung is not None:
+                outcome["rung"] = rung
             outcomes.append(outcome)
             telemetry.get().emit("serve", event="warmup", **outcome)
+
+        for h, w in self.buckets.sizes:
+            bucket = f"{h}x{w}"
+            img = jnp.zeros((self.batch_size, h, w, 3), dtype)
+
+            step = self.eval_fn
+            t0, c0, h0, s0 = _counts(step)
+            _, flow = step(self.variables, img, img)
+            jax.block_until_ready(flow)  # graftlint: disable=host-sync -- warm pool must finish before serving starts
+            _record(step, bucket, None, t0, c0, h0, s0)
+
+            if self.ladder is None:
+                continue
+            # ladder rungs: warm the base rung first, then feed its
+            # carry to every continuation increment (correct carry
+            # shapes without knowing the model's hidden width), then
+            # the monolithic full budget
+            lad = self.ladder
+            base = self._rung_fns[(lad.rungs[0], False)]
+            t0, c0, h0, s0 = _counts(base)
+            flow, state = base(self.variables, img, img)
+            jax.block_until_ready(flow)  # graftlint: disable=host-sync -- warm pool must finish before serving starts
+            _record(base, bucket, f"base:{lad.rungs[0]}", t0, c0, h0, s0)
+            for inc in sorted(set(lad.increments())):
+                step = self._rung_fns[(inc, True)]
+                t0, c0, h0, s0 = _counts(step)
+                flow, _ = step(self.variables, img, img,
+                               state["flow"], state["hidden"])
+                jax.block_until_ready(flow)  # graftlint: disable=host-sync -- warm pool must finish before serving starts
+                _record(step, bucket, f"cont:+{inc}", t0, c0, h0, s0)
+            step = self._rung_fns[(lad.rungs[-1], False)]
+            t0, c0, h0, s0 = _counts(step)
+            flow, _ = step(self.variables, img, img)
+            jax.block_until_ready(flow)  # graftlint: disable=host-sync -- warm pool must finish before serving starts
+            _record(step, bucket, f"full:{lad.rungs[-1]}", t0, c0, h0, s0)
         return outcomes

@@ -296,6 +296,7 @@ def serve_stats(events):
     buckets = {}
     warmups = []
     spans = {}
+    classes = {}
     for e in events:
         if e["kind"] != "serve":
             continue
@@ -304,6 +305,15 @@ def serve_stats(events):
             requests.append(e)
             for name, secs in e.get("spans", {}).items():
                 spans.setdefault(name, []).append(secs)
+            # ladder requests carry their latency class + the iteration
+            # budget actually spent (the adaptive classes vary it)
+            k = e.get("klass")
+            if k:
+                c = classes.setdefault(
+                    k, {"lat": [], "iterations": {}, "rungs": {}})
+                c["lat"].append(e.get("seconds", 0.0))
+                it = e.get("iterations", 0)
+                c["iterations"][it] = c["iterations"].get(it, 0) + 1
         elif ev == "reject":
             reason = e.get("reason", "?")
             rejects[reason] = rejects.get(reason, 0) + 1
@@ -317,6 +327,12 @@ def serve_stats(events):
             b["requests"] += e.get("size", 0)
             b["fill"] += e.get("fill", 0)
             b["compiles"] += e.get("compiles", 0)
+            k = e.get("klass")
+            if k:
+                c = classes.setdefault(
+                    k, {"lat": [], "iterations": {}, "rungs": {}})
+                rung = e.get("rungs", 0)
+                c["rungs"][rung] = c["rungs"].get(rung, 0) + 1
         elif ev == "warmup":
             warmups.append(e)
     if not (requests or rejects or errors or buckets or warmups):
@@ -333,11 +349,19 @@ def serve_stats(events):
         "spans_s": {name: sum(vals) / len(vals)
                     for name, vals in sorted(spans.items())},
         "buckets": buckets,
+        "classes": {k: {
+            "requests": len(c["lat"]),
+            "p50_s": _percentile(sorted(c["lat"]), 0.50),
+            "p99_s": _percentile(sorted(c["lat"]), 0.99),
+            "iterations": dict(sorted(c["iterations"].items())),
+            "rungs": dict(sorted(c["rungs"].items())),
+        } for k, c in sorted(classes.items())},
         "warmups": [{
             "model": w.get("model", "?"), "bucket": w.get("bucket", "?"),
             "wire": w.get("wire", "?"), "compiles": w.get("compiles", 0),
             "aot_hits": w.get("aot_hits", 0),
             "aot_saves": w.get("aot_saves", 0),
+            "rung": w.get("rung"),
         } for w in warmups],
     }
 
@@ -495,16 +519,24 @@ def render(events, errors=(), warmup_steps=DEFAULT_WARMUP_STEPS,
                 lines.append("spans:   " + ", ".join(
                     f"{name} {secs * 1e3:.1f} ms"
                     for name, secs in spans.items()))
+        for k, c in sorted(srv.get("classes", {}).items()):
+            its = ", ".join(f"{n} its x{cnt}"
+                            for n, cnt in c["iterations"].items())
+            lines.append(
+                f"  class {k:<9} {c['requests']:>4d} requests: "
+                f"p50 {c['p50_s'] * 1e3:.1f} ms, "
+                f"p99 {c['p99_s'] * 1e3:.1f} ms [{its or '-'}]")
         for key, b in sorted(srv["buckets"].items()):
             lines.append(
                 f"  bucket {key:<12} {b['requests']:>6d} requests in "
                 f"{b['batches']} batches ({b['fill']} pad fill), "
                 f"{b['compiles']} compiles")
         for w in srv["warmups"]:
+            rung = f", rung {w['rung']}" if w.get("rung") else ""
             lines.append(
-                f"  warm pool {w['model']}[{w['bucket']}] ({w['wire']}): "
-                f"{w['compiles']} compiles, {w['aot_hits']} AOT hits, "
-                f"{w['aot_saves']} AOT saves")
+                f"  warm pool {w['model']}[{w['bucket']}] ({w['wire']}"
+                f"{rung}): {w['compiles']} compiles, {w['aot_hits']} AOT "
+                f"hits, {w['aot_saves']} AOT saves")
 
     aot = aot_stats(events)
     if aot["boot"] or aot["programs"]:

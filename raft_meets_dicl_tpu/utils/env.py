@@ -137,6 +137,9 @@ KNOBS = dict([
     _k("RMD_FS_VOLUME_GIB", "float", 4.0,
        "raft/fs correlation-volume HBM budget steering the "
        "volume/windowed dispatch (per chip)", "models"),
+    _k("RMD_ITERATIONS", "int", 0,
+       "recurrence iteration override for evaluation (0 = model "
+       "default); CLI --iterations wins", "models"),
     # -- serving -----------------------------------------------------------
     _k("RMD_SERVE_BUCKETS", "str", None,
        "canonical request shapes for the serve command ('HxW,HxW,...'); "
@@ -150,6 +153,12 @@ KNOBS = dict([
     _k("RMD_SERVE_QUEUE", "int", 64,
        "per-bucket admission queue bound; requests beyond it shed with a "
        "typed queue_full rejection", "serve"),
+    _k("RMD_LADDER", "str", "4,8,12",
+       "iteration-ladder rung budgets for serve latency classes; CLI "
+       "--ladder / config wins", "serve"),
+    _k("RMD_LADDER_THRESHOLD", "float", 0.1,
+       "flow-delta norm (coarse-grid px) below which the balanced class "
+       "stops escalating rungs", "serve"),
     # -- fault injection / harness -----------------------------------------
     _k("RMD_FAULT", "str", "",
        "deterministic fault injection spec (testing.faults)", "faults"),
