@@ -9,9 +9,14 @@ Public surface:
 - ``hlo.audit_registry()`` — lower/compile-time audit of the registered
   step programs: fingerprint stability, collective counts, f32 convs,
   baked-in constants.
+- ``cost.audit_costs()`` — graftcost: static per-op FLOP/byte cost
+  model over the lowered StableHLO (MXU tile-utilization verdicts,
+  f32-upcast / gather-scalarization hazards) plus the
+  ``collectives`` sharding-contract diff, gated against the pinned
+  per-program budgets in ``hlo-budget.json``.
 
-``scripts/graftlint.py`` is the CLI; the ``lint``-marked tests run both
-passes in tier-1.
+``scripts/graftlint.py`` and ``scripts/graftcost.py`` are the CLIs; the
+``lint``- and ``cost``-marked tests run the passes in tier-1.
 
 The lint half never *uses* jax (no tracing, no device access — pure
 ``ast`` over source text), so it runs anywhere the package imports,

@@ -15,7 +15,15 @@ checks hold that together:
   their registry row and README line);
 - **env-docs (project)**: the committed README table between the
   generation markers must match ``env.readme_table()`` byte for byte
-  (``scripts/graftlint.py --fix-knob-table`` rewrites it).
+  (``scripts/graftlint.py --fix-knob-table`` rewrites it);
+- **env-dead-knob (project)**: every registered knob must be *read*
+  through a typed accessor (``get``/``get_bool``/``get_int``/
+  ``get_float``/``get_str``/``raw``/``is_set``/``knob``) somewhere in
+  the lint surface. Stricter than the reference check above: a knob
+  that tests still save/restore (a write) or a docstring still names
+  stays "referenced" long after the code path that *consumed* it died
+  in a refactor — registry row and README line intact, knob silently a
+  no-op for every user who sets it.
 """
 
 import ast
@@ -26,6 +34,12 @@ from .lint import Finding, Rule
 
 RULE = "env-knob"
 DOCS_RULE = "env-docs"
+DEAD_RULE = "env-dead-knob"
+
+# the sanctioned read surface of utils.env: a registered knob is *live*
+# iff some call through one of these names passes its literal
+ACCESSORS = frozenset({"get", "get_bool", "get_int", "get_float",
+                       "get_str", "raw", "is_set", "knob"})
 
 ENV_MODULE = "raft_meets_dicl_tpu/utils/env.py"
 KNOB_RE = re.compile(r"^RMD_[A-Z0-9_]+$")
@@ -134,6 +148,45 @@ def check_project(ctx):
     return findings
 
 
+def check_dead_knobs(ctx):
+    """Registered knobs no typed accessor ever reads — dead controls.
+
+    Direct ``environ`` reads also count as live (they draw their own
+    ``env-knob`` finding; double-reporting the knob as dead on top would
+    punish the same line twice). The accessor match is by call-name
+    suffix, deliberately loose: ``rmd_env.get_bool(...)``, ``env.raw``,
+    a bare ``get_int`` after ``from ..utils.env import get_int`` all
+    count. Over-matching (some unrelated ``.get("RMD_X")``) only makes
+    a knob *live*, never falsely dead — the safe direction for a gate.
+    """
+    if not _covers_env_module(ctx):
+        return []
+    env = _knobs()
+    read = set()
+    for m in ctx.modules:
+        if m.rel == ENV_MODULE:
+            continue
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.Call) and node.args:
+                dotted = astutil.dotted_name(node.func) or ""
+                if dotted.rpartition(".")[2] in ACCESSORS:
+                    name = _knob_literal(node.args[0])
+                    if name:
+                        read.add(name)
+        for _node, name in _environ_read_calls(m.tree):
+            read.add(name)
+    return [
+        Finding(
+            rule=DEAD_RULE, path=ENV_MODULE, line=1,
+            message=f"dead knob {name}: registered in utils.env.KNOBS "
+                    f"but never read through a typed accessor — the "
+                    f"code path that consumed it is gone; drop the "
+                    f"registry row (and regenerate the README table) "
+                    f"or re-wire the read")
+        for name in sorted(set(env.KNOBS) - read)
+    ]
+
+
 def check_docs(ctx):
     if not _covers_env_module(ctx):
         return []
@@ -170,4 +223,9 @@ RULES = [
          doc="README env-knob table generated from utils.env.KNOBS "
              "must not drift",
          project=check_docs),
+    Rule(name=DEAD_RULE,
+         doc="registered knobs must be read through a typed utils.env "
+             "accessor somewhere (a knob nothing reads is a silent "
+             "no-op for everyone who sets it)",
+         project=check_dead_knobs),
 ]

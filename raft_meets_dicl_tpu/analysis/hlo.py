@@ -117,13 +117,18 @@ def audit_compiled(text):
 
 
 def audit_program(program, args, expect_bf16=False, n_devices=1,
-                  expect_gather=False, do_compile=True):
+                  expect_gather=False, do_compile=True, **cost_context):
     """Audit one registered program against concrete example args.
 
     Returns ``(report, findings)``. The program is lowered twice for the
     fingerprint-stability check; when ``do_compile``, the second lowering
     is compiled (persistent-cache eligible) and its post-GSPMD HLO
     provides the collective counts.
+
+    ``cost_context`` (``partitioner``/``params``) is accepted and unused:
+    the builders below return one ``(program, args, audit_kwargs)`` list
+    shared with ``analysis.cost``, whose collective-contract auditor
+    consumes those keys.
     """
     path = "analysis/hlo"  # findings anchor to the audit, not a file
     key = program.key.canonical() if program.key else program.label
@@ -266,7 +271,9 @@ def build_flagship_programs(n_devices=2, shape=(48, 64), mesh2d=False):
 
     out = []
     out.append((train_prog, (state, *batch),
-                {"n_devices": n_devices, "expect_gather": expect_gather}))
+                {"n_devices": n_devices, "expect_gather": expect_gather,
+                 "partitioner": partitioner,
+                 "params": variables["params"]}))
     out.append((eval_prog, (eval_variables, batch[0], batch[1]),
                 {"n_devices": n_devices}))
     return out

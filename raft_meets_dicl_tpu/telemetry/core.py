@@ -78,6 +78,11 @@ SCHEMA = {
     # finding when the lint pass runs with a telemetry sink attached;
     # status is open | baselined | suppressed, severity error | warn
     "lint": {"rule", "path", "line", "status"},
+    # graftcost static cost model (PR 12): one event per audited
+    # program — deterministic StableHLO-walker FLOP/byte totals,
+    # arithmetic intensity, compiled collective-schedule bytes, and the
+    # tile-utilization verdict / hazard counts the budget gate pins
+    "cost": {"program", "flops", "bytes"},
     # serving path (serve/): event is request (success, with
     # admission/queue/dispatch/device latency spans) | error (typed
     # per-request failure, kind = malformed | oversized | decode |

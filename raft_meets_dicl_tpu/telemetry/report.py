@@ -223,6 +223,18 @@ def lint_stats(events):
             "open": open_findings}
 
 
+def cost_stats(events):
+    """Aggregate ``cost`` events (graftcost per-program summaries
+    forwarded via ``analysis.cost.emit_events``): one row per audited
+    program plus hazard totals across the set."""
+    programs = [e for e in events if e["kind"] == "cost"]
+    hazards = {}
+    for e in programs:
+        for name, n in (e.get("hazards") or {}).items():
+            hazards[name] = hazards.get(name, 0) + n
+    return {"programs": programs, "hazards": hazards}
+
+
 def fault_events(events):
     """The run's fault-tolerance trail, in order: non-finite skips and
     rollbacks, preemption stops, auto-resume pickups, checkpoint
@@ -625,6 +637,25 @@ def render(events, errors=(), warmup_steps=DEFAULT_WARMUP_STEPS,
         for e in lint["open"]:
             lines.append(f"  ! {e['path']}:{e['line']}: {e['rule']}: "
                          f"{e.get('message', '')}")
+
+    cost = cost_stats(events)
+    if cost["programs"]:
+        lines.append("")
+        lines.append(f"== program costs ({len(cost['programs'])} "
+                     f"programs) ==")
+        for e in cost["programs"]:
+            verd = ", ".join(f"{k}={v}" for k, v in
+                             sorted((e.get("verdicts") or {}).items()))
+            lines.append(
+                f"{e.get('program', '?')[:72]}: "
+                f"{e['flops'] / 1e6:.1f} MFLOP, "
+                f"{e['bytes'] / 2**20:.1f} MiB, "
+                f"{e.get('intensity', 0):.1f} flop/B, collectives "
+                f"{e.get('collective_bytes', 0) / 2**20:.2f} MiB"
+                + (f" [{verd}]" if verd else ""))
+        if cost["hazards"]:
+            lines.append("  hazards: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(cost["hazards"].items())))
 
     if memory:
         peak_rss = max(m["host_rss_gib"] for m in memory)

@@ -1,0 +1,100 @@
+#!/usr/bin/env python3
+"""graftcost CLI: static HLO cost model + sharding-contract audit,
+gated against the pinned per-program budgets in ``hlo-budget.json``.
+
+Walks every registered audit program (flagship train/eval, the (4, 2)-
+mesh SPMD variant, the iteration-ladder rungs), computes deterministic
+per-op-class FLOP/byte totals from the lowered StableHLO, diffs the
+compiled collective schedule against the partitioner-derived
+expectation, and enforces the pinned budgets: flops/bytes/collective
+bytes within tolerance, hazard and resharding counts no worse than
+grandfathered, no unpinned programs, stale pins reported.
+
+    python scripts/graftcost.py                    # audit vs hlo-budget.json
+    python scripts/graftcost.py --update           # re-pin after a deliberate change
+    python scripts/graftcost.py --format json      # machine-readable report
+    python scripts/graftcost.py --no-mesh2d        # skip the 8-device SPMD variant
+    python scripts/graftcost.py --events out.jsonl # per-program 'cost' telemetry
+
+Exit codes: 0 — every audited program within budget (stale pins alone
+don't fail; prune them with --update); 1 — findings (budget drift,
+hazard growth, contract violation, unpinned program); 2 — usage error.
+"""
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+from raft_meets_dicl_tpu.analysis import cost  # noqa: E402
+
+
+def json_report(report):
+    """Stable machine-readable schema (see also graftlint --format json):
+    bump ``schema`` on any incompatible change."""
+    out = report.to_dict()
+    out["schema"] = 1
+    out["exit_code"] = 0 if report.ok else 1
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        epilog="exit codes: 0 within budget; 1 findings; 2 usage error")
+    ap.add_argument("--budget", default=None, metavar="FILE",
+                    help=f"pinned budget JSON (default: <repo>/"
+                         f"{cost.BUDGET_NAME})")
+    ap.add_argument("--update", action="store_true",
+                    help="re-pin the budget file from this run's numbers "
+                         "(drops stale entries) instead of gating")
+    ap.add_argument("--format", choices=("text", "json"), default="text",
+                    help="report format (default: text)")
+    ap.add_argument("--no-mesh2d", action="store_true",
+                    help="skip the 8-device (4, 2)-mesh SPMD variant "
+                         "(faster; its pins then report stale)")
+    ap.add_argument("--events", default=None, metavar="FILE",
+                    help="append per-program 'cost' telemetry events")
+    args = ap.parse_args(argv)
+
+    budget_path = Path(args.budget) if args.budget else \
+        Path(__file__).parent.parent / cost.BUDGET_NAME
+    budget = (cost.Budget.load(budget_path) if budget_path.exists()
+              else cost.Budget.empty())
+
+    entries = cost.build_entries(include_mesh2d=not args.no_mesh2d)
+    report = cost.audit_costs(entries=entries, budget=budget)
+
+    if args.events:
+        from raft_meets_dicl_tpu import telemetry
+
+        tele = telemetry.Telemetry(args.events)
+        try:
+            cost.emit_events(report, tele)
+        finally:
+            tele.close()
+
+    if args.update:
+        budget.path = str(budget_path)
+        budget_path.write_text(
+            json.dumps(budget.pinned_data(report.reports), indent=2)
+            + "\n")
+        print(f"pinned {len(report.reports)} program budget(s) -> "
+              f"{budget_path}")
+        dropped = [k for k in report.stale]
+        for k in dropped:
+            print(f"  dropped stale entry: {k}")
+        return 0
+
+    if args.format == "json":
+        json.dump(json_report(report), sys.stdout, indent=2)
+        print()
+    else:
+        print(cost.render_reports(report))
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -245,6 +245,55 @@ def test_envknob_project_catches_typo_and_stale(tmp_path):
     assert any("stale knob" in f.message for f in found)
 
 
+def test_envknob_dead_rule_needs_an_accessor_read(tmp_path):
+    # a knob that is written, saved/restored, and name-dropped in a
+    # docstring is still *dead* until something reads it through a
+    # typed accessor — this is what separates env-dead-knob from the
+    # reference check in check_project
+    knob = next(iter(_real_knobs()))
+    mentions_only = mk(f"""
+        import os
+
+        def save_restore():
+            '''round-trips {knob} around a fault drill'''
+            old = os.environ.pop("{knob}", None)
+            os.environ["{knob}"] = "1"
+        """)
+    ctx = lint.ProjectContext(tmp_path, [mentions_only, _env_module_stub()])
+    dead = {f.message.split(":")[0] for f in envknobs.check_dead_knobs(ctx)}
+    assert f"dead knob {knob}" in dead
+
+    reader = mk(f"""
+        from raft_meets_dicl_tpu.utils import env
+
+        flag = env.get_bool("{knob}")
+        """, rel="raft_meets_dicl_tpu/models/reader.py")
+    ctx = lint.ProjectContext(
+        tmp_path, [mentions_only, reader, _env_module_stub()])
+    dead = {f.message.split(":")[0] for f in envknobs.check_dead_knobs(ctx)}
+    assert f"dead knob {knob}" not in dead
+    # every finding names the registry module, not the mentioning file
+    for f in envknobs.check_dead_knobs(ctx):
+        assert f.path == envknobs.ENV_MODULE
+
+    # a direct environ read keeps the knob live too (it already draws
+    # its own env-knob finding; no double jeopardy)
+    env_reader = mk(f"""
+        import os
+
+        raw = os.environ.get("{knob}")
+        """, rel="raft_meets_dicl_tpu/models/envreader.py")
+    ctx = lint.ProjectContext(
+        tmp_path, [mentions_only, env_reader, _env_module_stub()])
+    dead = {f.message.split(":")[0] for f in envknobs.check_dead_knobs(ctx)}
+    assert f"dead knob {knob}" not in dead
+
+
+def _real_knobs():
+    from raft_meets_dicl_tpu.utils import env
+    return env.KNOBS
+
+
 def test_envdocs_detects_missing_and_stale_table(tmp_path):
     from raft_meets_dicl_tpu.utils import env
 
@@ -361,6 +410,69 @@ def test_cli_exit_codes(tmp_path):
         [sys.executable, str(script), "--root", str(tmp_path)],
         capture_output=True, text=True)
     assert good.returncode == 0, good.stdout + good.stderr
+
+
+def _graftlint_cli():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "graftlint_cli", REPO / "scripts" / "graftlint.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+HOTSYNC_SRC = "import jax\n\ndef f(x):\n    return float(x)\n"
+
+
+def test_prune_drops_only_stale_baseline_entries(tmp_path):
+    cli = _graftlint_cli()
+    (tmp_path / "main.py").write_text(HOTSYNC_SRC)
+    path = tmp_path / lint.BASELINE_NAME
+    path.write_text(json.dumps({
+        "version": 1,
+        "comment": "header note that must survive the rewrite",
+        "entries": [
+            {"rule": "host-sync", "glob": "main.py",
+             "justification": "grandfathered"},
+            {"rule": "host-sync", "glob": "gone/*.py",
+             "justification": "module deleted two PRs ago"},
+        ],
+    }))
+    assert cli.prune_baseline(tmp_path, str(path)) == 0
+    data = json.loads(path.read_text())
+    # only the entry that matched nothing is gone; header rides through
+    assert [e["glob"] for e in data["entries"]] == ["main.py"]
+    assert data["comment"] == "header note that must survive the rewrite"
+    assert data["version"] == 1
+    # idempotent: a second prune is a no-op
+    before = path.read_text()
+    assert cli.prune_baseline(tmp_path, str(path)) == 0
+    assert path.read_text() == before
+    # the pruned baseline still fully suppresses the tree
+    rep = lint.run(tmp_path, baseline=lint.Baseline.load(path))
+    assert rep.ok and len(rep.baselined) == 1 and not rep.stale_baseline
+
+
+def test_json_report_schema_and_exit_code_contract(tmp_path):
+    cli = _graftlint_cli()
+    bad = run_fixture(tmp_path, HOTSYNC_SRC)
+    payload = cli.json_report(bad)
+    assert payload["schema"] == 1
+    assert payload["ok"] is False and payload["exit_code"] == 1
+    assert payload["open"] >= 1
+    f = payload["findings"][0]
+    assert {"rule", "path", "line", "severity", "status",
+            "message"} <= set(f)
+    json.dumps(payload)  # must be serializable as-is
+
+    good = run_fixture(tmp_path, "x = 1\n")
+    payload = cli.json_report(good)
+    assert payload["ok"] is True and payload["exit_code"] == 0
+    assert payload["stale_baseline_entries"] == []
+    # --hlo attaches program reports under a dedicated key
+    payload = cli.json_report(good, hlo_reports=[{"program": "p"}])
+    assert payload["hlo"] == [{"program": "p"}]
 
 
 # -- HLO auditor -------------------------------------------------------------
