@@ -421,8 +421,18 @@ def _bench_serve():
         mark = len(getattr(tele, "events", ()))
         sched = serve.Scheduler(session, max_wait_ms=20.0,
                                 queue_limit=64).start()
+        if not sched.slo:
+            # no RMD_SLO_* knobs set: pin a bench-local default target so
+            # the attainment/burn columns always render
+            from raft_meets_dicl_tpu.telemetry import slo as rmd_slo
+            sched.slo = rmd_slo.SLOTracker(
+                class_targets={"": float(os.environ.get(
+                    "BENCH_SERVE_SLO_MS", "250"))},
+                objective=0.99, window_s=300.0)
         report = serve.loadgen.run_open_loop(
             sched, shapes, requests=requests, rate_hz=rate)
+        slo_snap = sched.slo.snapshot()
+        trace_snap = sched.trace_summary.snapshot()
         sched.stop(drain=True)
         tail = getattr(tele, "events", [])[mark:]
         serve_compiles = [e for e in tail if e["kind"] == "compile"
@@ -439,6 +449,16 @@ def _bench_serve():
             "p50_ms": report["p50_ms"],
             "p99_ms": report["p99_ms"],
             "spans_ms": report["spans_ms"],
+            # per-class SLO attainment over the stream + the slowest-decile
+            # critical-path breakdown (queue vs batch-formation vs device)
+            "slo": {(k or "default"): {
+                "target_ms": s["target_ms"],
+                "attainment": s["attainment"],
+                "burn_rate": s["burn_rate"],
+            } for k, s in slo_snap.items()},
+            "classes": {(k or "default"): c
+                        for k, c in trace_snap["classes"].items()},
+            "tail": trace_snap["tail"],
             # zero expected in every phase: partial batches ride the full
             # batch's compiled program, so serving never compiles
             "serve_compiles": len(serve_compiles),

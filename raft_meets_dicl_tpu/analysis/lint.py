@@ -193,10 +193,10 @@ class ProjectContext:
 
 
 def default_rules():
-    from . import envknobs, hostsync, precision, tracerflow
+    from . import envknobs, hostsync, precision, telemetrykinds, tracerflow
 
     rules = []
-    for mod in (hostsync, tracerflow, precision, envknobs):
+    for mod in (hostsync, tracerflow, precision, envknobs, telemetrykinds):
         rules.extend(mod.RULES)
     return rules
 

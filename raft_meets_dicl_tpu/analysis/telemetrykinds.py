@@ -1,0 +1,117 @@
+"""Rule ``telemetry-unregistered-kind``: the telemetry schema contract.
+
+The event schema (``telemetry.core.SCHEMA``) and the metric namespace
+(``telemetry.metrics.NAME_RE``) are the two registries the live
+observability plane stands on — the offline report, the Prometheus
+scrape, and the fleet router all consume them by name. Two static
+checks keep producers honest:
+
+- every ``emit("<kind>", ...)`` call site (positional or ``kind=``
+  keyword string literal) must name a kind declared in SCHEMA —
+  ``validate_event`` would reject the record at runtime, but only on
+  the code path that actually fires, which for rare kinds (faults,
+  preemption) is exactly the path tests miss;
+- every metric registered through ``.counter(...)`` / ``.gauge(...)`` /
+  ``.histogram(...)`` with a string-literal name must match the
+  ``rmd_<subsystem>_<name>`` convention (counters additionally end in
+  ``_total``), so the scrape namespace stays collision-free and
+  greppable.
+
+Only string-literal names are checked (a computed kind is the schema's
+validate-at-runtime problem); non-telemetry ``.emit``/``.histogram``
+receivers with non-literal args never match. Baseline-able like every
+rule.
+"""
+
+import ast
+
+from . import astutil
+from .lint import Finding, Rule
+
+RULE = "telemetry-unregistered-kind"
+
+METRIC_METHODS = ("counter", "gauge", "histogram")
+
+
+def _schema():
+    from ..telemetry import core
+    return core.SCHEMA
+
+
+def _metric_name_re():
+    from ..telemetry import metrics
+    return metrics.NAME_RE
+
+
+def _literal(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _emit_kind(node):
+    """The string-literal kind of an ``emit(...)`` call, else None."""
+    dotted = astutil.dotted_name(node.func) or ""
+    if dotted.rpartition(".")[2] != "emit":
+        return None
+    if node.args:
+        return _literal(node.args[0])
+    for kw in node.keywords:
+        if kw.arg == "kind":
+            return _literal(kw.value)
+    return None
+
+
+def _metric_registration(node):
+    """(method, string-literal metric name) for registry registrations,
+    else None. Attribute calls only: a bare ``histogram(...)`` is
+    someone's numpy import, not the registry."""
+    fn = node.func
+    if not isinstance(fn, ast.Attribute) or fn.attr not in METRIC_METHODS:
+        return None
+    if not node.args:
+        return None
+    name = _literal(node.args[0])
+    if name is None:
+        return None
+    return fn.attr, name
+
+
+def check(module):
+    schema = _schema()
+    name_re = _metric_name_re()
+    findings = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        kind = _emit_kind(node)
+        if kind is not None and kind not in schema:
+            findings.append(Finding(
+                rule=RULE, path=module.rel, line=node.lineno,
+                message=f"emit of unregistered event kind {kind!r}: "
+                        f"declare it in telemetry.core.SCHEMA (with its "
+                        f"required fields) or fix the typo"))
+        reg = _metric_registration(node)
+        if reg is not None:
+            method, name = reg
+            if not name_re.match(name):
+                findings.append(Finding(
+                    rule=RULE, path=module.rel, line=node.lineno,
+                    message=f"metric name {name!r} breaks the "
+                            f"rmd_<subsystem>_<name> convention "
+                            f"(lower-snake, rmd_ prefix, >= 3 segments)"))
+            elif method == "counter" and not name.endswith("_total"):
+                findings.append(Finding(
+                    rule=RULE, path=module.rel, line=node.lineno,
+                    message=f"counter {name!r} must end in _total "
+                            f"(Prometheus counter convention)"))
+    return findings
+
+
+RULES = [
+    Rule(name=RULE,
+         doc="emit() kinds must be declared in telemetry.core.SCHEMA; "
+             "metric names must match rmd_<subsystem>_<name> (counters "
+             "ending _total)",
+         check=check),
+]

@@ -49,7 +49,11 @@ def serve(args):
 
     tele = telemetry.get()
     if getattr(args, "telemetry", None):
-        tele = telemetry.activate(telemetry.create(Path(args.telemetry)))
+        # serve uses the non-blocking sink: disk writes ride a bounded
+        # background queue, a slow disk sheds trace events (counted)
+        # instead of backpressuring the scheduler
+        tele = telemetry.activate(
+            telemetry.create(Path(args.telemetry), nonblocking=True))
         if tele.path:
             logging.info(f"writing telemetry events to '{tele.path}'")
     tele.emit(
@@ -149,6 +153,17 @@ def serve(args):
         session, batch_size=batch_size, max_wait_ms=max_wait_ms,
         queue_limit=queue_limit).start()
 
+    metrics_port = int(_pick(getattr(args, "metrics_port", None), cfg,
+                             "metrics-port",
+                             env.get_int("RMD_METRICS_PORT")) or 0)
+    observer = None
+    if metrics_port:
+        observer = serving.serve_observer(
+            session, scheduler, metrics_port, sink=tele)
+        logging.info(
+            f"observability plane at {observer.url}: /metrics /healthz "
+            f"/statusz /profilez")
+
     # built-in open-loop client: every bucket size plus an off-bucket
     # variant of each (exercises quantization + partial batches)
     shapes = []
@@ -166,6 +181,11 @@ def serve(args):
 
     report = serving.loadgen.run_open_loop(
         scheduler, shapes, requests=requests, rate_hz=rate, classes=classes)
+    if scheduler.slo:
+        report["slo"] = scheduler.slo.snapshot()
+    tail = scheduler.trace_summary.tail()
+    if tail is not None:
+        report["tail"] = tail
     scheduler.stop(drain=True)
 
     logging.info(
@@ -174,5 +194,7 @@ def serve(args):
         f"{report['pairs_per_sec']:.2f} pairs/s")
     print(json.dumps(report))
 
+    if observer is not None:
+        observer.close()
     if getattr(args, "telemetry", None):
         telemetry.deactivate()

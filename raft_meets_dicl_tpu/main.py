@@ -263,6 +263,12 @@ def main():
                        help="write serve telemetry events (request "
                             "spans, batches, rejects, warm-pool "
                             "outcomes) to this JSONL file")
+    serve.add_argument("--metrics-port", type=int, metavar="PORT",
+                       help="observability HTTP port on 127.0.0.1: "
+                            "/metrics (Prometheus text), /healthz, "
+                            "/statusz, /profilez?seconds=N (also: "
+                            "RMD_METRICS_PORT, the config's "
+                            "'metrics-port' key) [default: off]")
 
     # subcommand: checkpoint
     chkpt = subp.add_parser("checkpoint", formatter_class=fmtcls,

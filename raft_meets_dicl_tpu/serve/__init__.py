@@ -16,18 +16,23 @@ scheduler with bounded-queue admission control:
 - :mod:`.loadgen` — the open-loop synthetic load generator behind
   ``BENCH_SERVE=1`` and the ``serve`` CLI's built-in client;
 - :mod:`.ladder` — iteration-ladder latency classes (PR 11): adaptive
-  recurrence budgets over chained fixed-``iterations`` rung programs.
+  recurrence budgets over chained fixed-``iterations`` rung programs;
+- :mod:`.observe` — the live observability plane (PR 13): /metrics
+  (Prometheus text), /healthz readiness+liveness, /statusz snapshots,
+  /profilez on-demand profiler captures.
 """
 
-from . import batcher, ladder, loadgen, scheduler, session
+from . import batcher, ladder, loadgen, observe, scheduler, session
 from .batcher import (BucketBatcher, FlowRequest, FlowResult, ServeError,
                       ServeRejected)
 from .ladder import CLASSES, LadderSpec
+from .observe import Observer, ObserverServer, serve_observer
 from .scheduler import Scheduler, Ticket
 from .session import ServeSession
 
 __all__ = [
-    "batcher", "ladder", "loadgen", "scheduler", "session",
+    "batcher", "ladder", "loadgen", "observe", "scheduler", "session",
     "BucketBatcher", "CLASSES", "FlowRequest", "FlowResult", "LadderSpec",
+    "Observer", "ObserverServer", "serve_observer",
     "ServeError", "ServeRejected", "Scheduler", "Ticket", "ServeSession",
 ]

@@ -79,6 +79,7 @@ class FlowRequest:
     t_enqueue: float = 0.0
     klass: str = ""  # latency class ("" = plain eval, no ladder)
     spans: Dict[str, float] = field(default_factory=dict)
+    trace: Any = None  # telemetry.trace.RequestTrace (None = untraced)
 
 
 @dataclass
@@ -151,6 +152,17 @@ class BucketBatcher:
 
     def pending(self) -> int:
         return sum(len(q) for q in self._queues.values())
+
+    def depths(self) -> Dict[str, int]:
+        """Per-lane queue depths keyed ``HxW/klass`` (klass omitted for
+        the empty ladderless class) — the /statusz live snapshot."""
+        out = {}
+        for (bucket, klass), q in sorted(self._queues.items()):
+            name = f"{bucket[0]}x{bucket[1]}"
+            if klass:
+                name = f"{name}/{klass}"
+            out[name] = len(q)
+        return out
 
     def take(self, now, max_wait_s, drain=False):
         """Next dispatchable batch, or the wake-up deadline.

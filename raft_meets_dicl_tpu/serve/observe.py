@@ -1,0 +1,223 @@
+"""Live observability HTTP plane for one serve replica.
+
+A tiny stdlib HTTP server (daemon thread, no dependency) the fleet
+router and operators scrape:
+
+- ``/metrics`` — Prometheus text exposition of the ``rmd_*`` registry
+  (telemetry.metrics), with the scrape-time gauges (queue depth,
+  dropped telemetry events, readiness, per-class SLO burn) refreshed
+  just before render;
+- ``/healthz`` — readiness (warm pool complete: every bucket's program
+  compiled or AOT-loaded) and liveness (dispatch-loop heartbeat age
+  under the threshold); 200 only when both hold, 503 otherwise, JSON
+  body either way — the router's drain signal;
+- ``/statusz`` — JSON snapshot: per-lane queue depths, shed/error
+  counts, per-class p50/p99 plus the slowest-decile critical-path
+  breakdown (telemetry.trace.TraceSummary), SLO windows;
+- ``/profilez?seconds=N`` — on-demand ``jax.profiler`` capture to a
+  fresh directory (the generalized form of the train ``--profile``
+  hook), single-flight and capped so a scrape loop can't stack
+  captures.
+
+The server binds ``127.0.0.1`` (an observability sidecar, not the
+serving API) and ``port=0`` picks an ephemeral port (tests).
+"""
+
+import json
+import tempfile
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from ..telemetry import metrics as metrics_mod
+
+# liveness: the dispatch loop wakes at least every second
+# (scheduler._HEARTBEAT_WAKE_S); 10x that margin tolerates a loaded host
+STALE_HEARTBEAT_S = 10.0
+MAX_PROFILE_S = 60.0
+DEFAULT_PROFILE_S = 3.0
+
+
+class Observer:
+    """Aggregates one replica's live state for the HTTP plane and keeps
+    the scrape-time gauges fresh."""
+
+    def __init__(self, session, scheduler, sink=None, registry=None,
+                 stale_heartbeat_s=STALE_HEARTBEAT_S):
+        self.session = session
+        self.scheduler = scheduler
+        self.sink = sink
+        self.registry = registry or metrics_mod.registry()
+        self.stale_heartbeat_s = float(stale_heartbeat_s)  # graftlint: disable=host-sync -- config scalar, not a device value
+        self._profile_lock = threading.Lock()
+        self._m_ready = self.registry.gauge(
+            "rmd_serve_ready", "replica readiness (warm pool complete)")
+        self._m_heartbeat = self.registry.gauge(
+            "rmd_serve_heartbeat_age_seconds",
+            "seconds since the dispatch loop last went around")
+        self._m_dropped = self.registry.gauge(
+            "rmd_telemetry_dropped_total",
+            "telemetry events shed by the bounded non-blocking buffer")
+        self._m_burn = self.registry.gauge(
+            "rmd_slo_burn_rate",
+            "per-class SLO burn rate over the rolling window", ("klass",))
+        self._m_attain = self.registry.gauge(
+            "rmd_slo_attainment",
+            "per-class SLO attainment over the rolling window", ("klass",))
+
+    # -- state ---------------------------------------------------------------
+
+    def ready(self):
+        return bool(getattr(self.session, "ready", False))
+
+    def heartbeat_age(self):
+        age = getattr(self.scheduler, "heartbeat_age", None)
+        return age() if age else 0.0
+
+    def live(self):
+        return self.heartbeat_age() < self.stale_heartbeat_s
+
+    def _refresh_gauges(self):
+        self._m_ready.set(1.0 if self.ready() else 0.0)
+        self._m_heartbeat.set(round(self.heartbeat_age(), 3))
+        if self.sink is not None:
+            self._m_dropped.set(self.sink.dropped())
+        slo = getattr(self.scheduler, "slo", None)
+        if slo:
+            for klass, snap in slo.snapshot().items():
+                label = klass or "default"
+                self._m_burn.labels(klass=label).set(snap["burn_rate"])
+                self._m_attain.labels(klass=label).set(snap["attainment"])
+
+    # -- endpoint payloads ---------------------------------------------------
+
+    def metrics_text(self):
+        self._refresh_gauges()
+        return self.registry.render()
+
+    def health(self):
+        ready, age = self.ready(), self.heartbeat_age()
+        live = age < self.stale_heartbeat_s
+        return {
+            "ready": ready,
+            "live": live,
+            "heartbeat_age_s": round(age, 3),
+        }, (200 if ready and live else 503)
+
+    def status(self):
+        sched = self.scheduler
+        summary = getattr(sched, "trace_summary", None)
+        slo = getattr(sched, "slo", None)
+        snap = summary.snapshot() if summary is not None else {}
+        depths = (sched.queue_depths()
+                  if hasattr(sched, "queue_depths") else {})
+        return {
+            "ready": self.ready(),
+            "heartbeat_age_s": round(self.heartbeat_age(), 3),
+            "queues": depths,
+            "pending": sum(depths.values()),
+            "requests": snap.get("count", 0),
+            "classes": snap.get("classes", {}),
+            "tail": snap.get("tail"),
+            "slo": slo.snapshot() if slo else {},
+            "telemetry_dropped": (self.sink.dropped()
+                                  if self.sink is not None else 0),
+        }
+
+    def profile(self, seconds):
+        """Capture ``seconds`` of jax profiler trace; returns the
+        directory holding the capture. Single-flight: a second request
+        while one runs gets a 409."""
+        seconds = min(max(float(str(seconds)), 0.1), MAX_PROFILE_S)
+        if not self._profile_lock.acquire(blocking=False):
+            raise ProfileBusy("a profile capture is already running")
+        try:
+            import jax
+
+            out = tempfile.mkdtemp(prefix="rmd-profilez-")
+            jax.profiler.start_trace(out)
+            time.sleep(seconds)
+            jax.profiler.stop_trace()
+            return {"dir": out, "seconds": seconds}
+        finally:
+            self._profile_lock.release()
+
+
+class ProfileBusy(RuntimeError):
+    pass
+
+
+class _Handler(BaseHTTPRequestHandler):
+    observer = None  # bound by serve_observer via subclass attribute
+
+    def log_message(self, fmt, *args):  # silence per-request stderr spam
+        pass
+
+    def _send(self, code, body, content_type="application/json"):
+        data = body if isinstance(body, bytes) else body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _send_json(self, code, payload):
+        self._send(code, json.dumps(payload, indent=2) + "\n")
+
+    def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler contract
+        url = urlparse(self.path)
+        obs = self.observer
+        try:
+            if url.path == "/metrics":
+                self._send(200, obs.metrics_text(),
+                           "text/plain; version=0.0.4; charset=utf-8")
+            elif url.path == "/healthz":
+                payload, code = obs.health()
+                self._send_json(code, payload)
+            elif url.path == "/statusz":
+                self._send_json(200, obs.status())
+            elif url.path == "/profilez":
+                qs = parse_qs(url.query)
+                seconds = qs.get("seconds", [DEFAULT_PROFILE_S])[0]
+                self._send_json(200, obs.profile(seconds))
+            else:
+                self._send_json(404, {"error": f"no route {url.path}"})
+        except ProfileBusy as e:
+            self._send_json(409, {"error": str(e)})
+        except Exception as e:  # noqa: BLE001 - scrape must not kill serve
+            self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
+
+
+class ObserverServer:
+    """The bound HTTP server + its daemon thread."""
+
+    def __init__(self, observer, port, host="127.0.0.1"):
+        handler = type("BoundHandler", (_Handler,), {"observer": observer})
+        self.observer = observer
+        self.httpd = ThreadingHTTPServer((host, int(port)), handler)  # graftlint: disable=host-sync -- TCP port number, not a device value
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="serve-observe",
+            daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self._thread.join(timeout=5.0)
+
+    @property
+    def url(self):
+        return f"http://{self.httpd.server_address[0]}:{self.port}"
+
+
+def serve_observer(session, scheduler, port, sink=None, registry=None):
+    """Build and start the observability server; returns the
+    :class:`ObserverServer` (``.port`` resolves port 0)."""
+    obs = Observer(session, scheduler, sink=sink, registry=registry)
+    return ObserverServer(obs, port).start()

@@ -31,10 +31,17 @@ import time
 import numpy as np
 
 from .. import telemetry
+from ..telemetry import metrics as metrics_mod
+from ..telemetry import slo as slo_mod
+from ..telemetry import trace as trace_mod
 from ..testing import faults
 from ..utils import env
 from .batcher import (BucketBatcher, FlowRequest, FlowResult, ServeError,
                       ServeRejected)
+
+# the dispatch loop wakes at least this often even when idle, so the
+# liveness heartbeat (observe.py /healthz) keeps advancing
+_HEARTBEAT_WAKE_S = 1.0
 
 
 class Ticket:
@@ -89,6 +96,34 @@ class Scheduler:
         self.batcher = BucketBatcher(session.buckets, batch_size, queue_limit)
         self.max_wait_s = float(max_wait_ms) / 1e3
 
+        # live observability plane: per-request trace summary, per-class
+        # SLO burn windows (empty unless RMD_SLO_* targets are set), and
+        # the rmd_serve_* metrics every instrumentation point feeds
+        self.trace_summary = trace_mod.TraceSummary()
+        self.slo = slo_mod.SLOTracker()
+        self._heartbeat = time.monotonic()
+        reg = metrics_mod.registry()
+        self._m_requests = reg.counter(
+            "rmd_serve_requests_total", "completed serve requests",
+            ("klass", "bucket"))
+        self._m_errors = reg.counter(
+            "rmd_serve_errors_total", "failed serve requests by typed kind",
+            ("error",))
+        self._m_shed = reg.counter(
+            "rmd_serve_shed_total", "admission rejections by reason",
+            ("reason",))
+        self._m_batches = reg.counter(
+            "rmd_serve_batches_total", "dispatched device batches",
+            ("bucket", "klass"))
+        self._m_fill = reg.counter(
+            "rmd_serve_fill_slots_total",
+            "pad-tile fill slots dispatched in partial batches")
+        self._m_latency = reg.histogram(
+            "rmd_serve_request_latency_seconds",
+            "end-to-end request latency (submit to release)", ("klass",))
+        self._m_depth = reg.gauge(
+            "rmd_serve_queue_depth", "queued requests across all lanes")
+
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._rid = 0
@@ -132,6 +167,7 @@ class Scheduler:
         except ServeError as e:
             # field name is 'error' (not 'kind'): the envelope's 'kind'
             # slot is the event kind itself
+            self._m_errors.labels(error=e.kind).inc()
             telemetry.get().emit("serve", event="error", rid=rid,
                                  client=client, error=e.kind)
             raise
@@ -139,17 +175,21 @@ class Scheduler:
         e1, e2 = self.batcher.encode_pair(img1, img2, bucket,
                                           self.session.encode_image)
         ticket = Ticket(rid, client)
+        rtrace = trace_mod.RequestTrace(klass=klass, bucket=bucket)
+        rtrace.mark("submit", t0)
         req = FlowRequest(rid=rid, client=client, seq=0, bucket=bucket,
                           shape=(h, w), img1=e1, img2=e2, ticket=ticket,
-                          t_submit=t0, klass=klass)
+                          t_submit=t0, klass=klass, trace=rtrace)
 
         with self._cond:
             if self._stopping:
+                self._m_shed.labels(reason="shutdown").inc()
                 telemetry.get().emit("serve", event="reject", rid=rid,
                                      client=client, reason="shutdown")
                 raise ServeRejected("shutdown")
             req.spans["admission"] = time.perf_counter() - t0
             if not self.batcher.offer(req):
+                self._m_shed.labels(reason="queue_full").inc()
                 telemetry.get().emit(
                     "serve", event="reject", rid=rid, client=client,
                     reason="queue_full", bucket=f"{bucket[0]}x{bucket[1]}")
@@ -157,6 +197,8 @@ class Scheduler:
                     "queue_full",
                     f"bucket {bucket[0]}x{bucket[1]} queue at bound "
                     f"({self.batcher.queue_limit})")
+            rtrace.mark("enqueue", req.t_enqueue)
+            self._m_depth.set(self.batcher.pending())
             req.seq = self._seq.get(client, 0)
             self._seq[client] = req.seq + 1
             self._cond.notify()
@@ -229,12 +271,24 @@ class Scheduler:
         with self._lock:
             return self.batcher.pending()
 
+    def heartbeat_age(self):
+        """Seconds since the dispatch loop last went around — the
+        /healthz liveness signal (the loop wakes at least every
+        ``_HEARTBEAT_WAKE_S`` even when idle)."""
+        return time.monotonic() - self._heartbeat
+
+    def queue_depths(self):
+        """Per-lane queue depths (``HxW[/klass]`` -> count)."""
+        with self._lock:
+            return self.batcher.depths()
+
     # -- dispatch loop -------------------------------------------------------
 
     def _loop(self):
         while True:
             with self._cond:
                 while True:
+                    self._heartbeat = time.monotonic()
                     now = time.perf_counter()
                     bucket, batch = self.batcher.take(
                         now, self.max_wait_s, drain=self._stopping)
@@ -243,8 +297,11 @@ class Scheduler:
                     if self._stopping:
                         return
                     deadline = batch  # (None, deadline) overload of take()
-                    timeout = (None if deadline is None
-                               else max(0.0, deadline - now))
+                    # idle waits are capped so the liveness heartbeat
+                    # keeps advancing with nothing queued
+                    timeout = (_HEARTBEAT_WAKE_S if deadline is None
+                               else min(_HEARTBEAT_WAKE_S,
+                                        max(0.0, deadline - now)))
                     self._cond.wait(timeout)
             try:
                 self._dispatch(bucket, batch)
@@ -266,11 +323,21 @@ class Scheduler:
                 live.append(r)
         if not live:
             return
+        klass = live[0].klass  # lanes are same-class by construction
+        # test stand-in sessions may not expose a program fingerprint
+        fingerprint = getattr(self.session, "program_fingerprint", None)
+        btrace = trace_mod.BatchTrace(
+            bucket, klass,
+            program=fingerprint(klass) if fingerprint else None)
+        btrace.t_start = t0
         for r in live:
             r.spans["queue"] = t0 - r.t_enqueue
+            if r.trace is not None:
+                r.trace.mark("dispatch", t0)
+                btrace.link(r.trace)
 
         img1, img2, fill = self.batcher.assemble(live)
-        klass = live[0].klass  # lanes are same-class by construction
+        btrace.fill = fill
         c0 = self.session.compiles()
         if klass:
             flow, info = self.session.run_ladder(img1, img2, klass)
@@ -280,6 +347,7 @@ class Scheduler:
         flow = self.session.fetch(flow)
         t2 = time.perf_counter()
 
+        tele = telemetry.get()
         batch_event = dict(
             bucket=f"{bucket[0]}x{bucket[1]}", size=len(live), fill=fill,
             compiles=self.session.compiles() - c0,
@@ -287,12 +355,22 @@ class Scheduler:
         if info is not None:
             batch_event.update(klass=klass, rungs=info["rungs"],
                                iterations=info["iterations"])
-        telemetry.get().emit("serve", event="batch", **batch_event)
+        tele.emit("serve", event="batch", **batch_event)
+        btrace.finish()
+        tele.emit("trace", event="batch", **btrace.record())
+        self._m_batches.labels(
+            bucket=f"{bucket[0]}x{bucket[1]}", klass=klass).inc()
+        if fill > 0:
+            self._m_fill.inc(fill)
+        self._m_depth.set(self.batcher.pending())
 
         for i, r in enumerate(live):
             h, w = r.shape
             r.spans["dispatch"] = t1 - t0
             r.spans["device"] = t2 - t1
+            if r.trace is not None:
+                r.trace.mark("launched", t1)
+                r.trace.mark("fetched", t2)
             self._complete(r, result=FlowResult(
                 rid=r.rid, client=r.client, bucket=bucket, shape=r.shape,
                 flow=flow[i, :h, :w, :], spans=r.spans, klass=klass,
@@ -323,7 +401,21 @@ class Scheduler:
                     seconds=round(total, 6),
                     spans={k: round(v, 6) for k, v in res.spans.items()},
                     **extra)
+                self._m_requests.labels(
+                    klass=r.klass,
+                    bucket=f"{r.bucket[0]}x{r.bucket[1]}").inc()
+                self._m_latency.labels(klass=r.klass).observe(total)
+                if r.trace is not None:
+                    r.trace.mark("released")
+                    record = r.trace.record()
+                    tele.emit("trace", event="request", rid=r.rid,
+                              **record)
+                    self.trace_summary.add(record)
+                self.slo.record(r.klass, total)
+                self.slo.maybe_emit(tele)
             else:
+                self._m_errors.labels(
+                    error=getattr(err, "kind", "internal")).inc()
                 tele.emit("serve", event="error", rid=r.rid,
                           client=r.client,
                           error=getattr(err, "kind", "internal"),

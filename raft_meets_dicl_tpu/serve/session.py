@@ -63,6 +63,10 @@ class ServeSession:
         # increments, monolithic full budget — all ProgramKey flag
         # variants that dedupe/AOT like the plain eval program
         self.ladder = ladder
+        # readiness for /healthz: flips once warm_pool() has compiled
+        # (or AOT-loaded) every bucket's program — before that a request
+        # would pay a cold compile the operator thinks was prepaid
+        self.ready = False
         self._rung_fns = {}
         if ladder is not None:
             for its, cont in ladder.programs():
@@ -250,4 +254,19 @@ class ServeSession:
             flow, _ = step(self.variables, img, img)
             jax.block_until_ready(flow)  # graftlint: disable=host-sync -- warm pool must finish before serving starts
             _record(step, bucket, f"full:{lad.rungs[-1]}", t0, c0, h0, s0)
+        self.ready = True
         return outcomes
+
+    def program_fingerprint(self, klass=""):
+        """Stable identity of the compiled program a batch of ``klass``
+        rides (registry ProgramKey canonical form) — the batch-trace
+        field that lets a tail batch be tied to one executable."""
+        fn = self.eval_fn
+        if klass and self.ladder is not None:
+            lad = self.ladder
+            rung = lad.rungs[-1] if klass == "quality" else lad.rungs[0]
+            fn = self._rung_fns.get((rung, False), fn)
+        key = getattr(fn, "key", None)
+        if key is not None:
+            return key.describe()
+        return getattr(fn, "telemetry_label", "eval_step")

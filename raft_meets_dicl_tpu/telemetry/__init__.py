@@ -15,12 +15,15 @@ producer usage::
 null sink and ``activate`` skips the jax.monitoring hookup).
 """
 
-from . import core, report
+from . import core, metrics, report, slo, trace
 from .core import (
     SCHEMA,
+    SCHEMA_MINOR,
     SCHEMA_VERSION,
+    NewerSchema,
     NullTelemetry,
     Telemetry,
+    UnknownKind,
     activate,
     create,
     deactivate,
@@ -34,8 +37,9 @@ from .core import (
 )
 
 __all__ = [
-    "core", "report",
-    "SCHEMA", "SCHEMA_VERSION", "NullTelemetry", "Telemetry",
+    "core", "metrics", "report", "slo", "trace",
+    "SCHEMA", "SCHEMA_MINOR", "SCHEMA_VERSION",
+    "NewerSchema", "NullTelemetry", "Telemetry", "UnknownKind",
     "activate", "create", "deactivate", "enabled", "get",
     "install_listeners", "instrument_jit", "jit_label",
     "memory_snapshot", "validate_event",

@@ -24,6 +24,7 @@ Design constraints, in order:
    to whatever sink is active.
 """
 
+import collections
 import contextlib
 import json
 import os
@@ -31,6 +32,13 @@ import threading
 import time
 
 SCHEMA_VERSION = 1
+
+# Minor revision within the major schema: bumped when kinds or optional
+# fields are *added*. Producers stamp the plain major in ``v`` (older
+# readers keep working); a reader seeing ``v`` with the same major but a
+# larger fractional minor (e.g. 1.2 from a newer producer) should skip
+# the record, not reject the file — see :class:`NewerSchema`.
+SCHEMA_MINOR = 1
 
 # kind -> required payload fields (beyond the {v, t, kind} envelope).
 # Extra fields are allowed everywhere: the schema pins the floor a
@@ -95,7 +103,29 @@ SCHEMA = {
     "quarantine": {"path"},
     "respawn": {"worker"},
     "bad_sample": {"index"},
+    # live observability plane (PR 13): event is request (one completed
+    # request with its trace id, batch linkage and exact critical-path
+    # phase decomposition — phases sum to total) | batch (one dispatch
+    # span: batch id, bucket/class, member trace ids, compiled-program
+    # fingerprint)
+    "trace": {"event"},
+    # rolling per-latency-class SLO window: attainment = good/(good+bad)
+    # within window_s, burn_rate = (1-attainment)/(1-objective) — burn
+    # > 1 means the class is missing its objective at the current rate
+    "slo": {"klass", "target_ms", "attainment", "burn_rate"},
 }
+
+
+class UnknownKind(ValueError):
+    """An event kind this reader's SCHEMA doesn't know — typically a
+    file written by a newer producer. Readers that want forward compat
+    catch this and skip the record; everything else treats it as the
+    plain ValueError it is."""
+
+
+class NewerSchema(ValueError):
+    """Same major schema version, newer minor revision — the record is
+    from a newer producer and safe to skip, not a corrupt line."""
 
 _FLUSH_EVERY = 128
 _EMA_ALPHA = 0.1
@@ -109,13 +139,18 @@ def validate_event(ev):
     """
     if not isinstance(ev, dict):
         raise ValueError(f"event is not an object: {ev!r}")
-    if ev.get("v") != SCHEMA_VERSION:
-        raise ValueError(f"unknown schema version {ev.get('v')!r}: {ev!r}")
+    v = ev.get("v")
+    if v != SCHEMA_VERSION:
+        if (isinstance(v, float) and not isinstance(v, bool)
+                and int(v) == SCHEMA_VERSION and v > SCHEMA_VERSION):
+            raise NewerSchema(
+                f"newer minor schema revision {v!r}: {ev!r}")
+        raise ValueError(f"unknown schema version {v!r}: {ev!r}")
     if not isinstance(ev.get("t"), (int, float)):
         raise ValueError(f"missing/invalid timestamp: {ev!r}")
     kind = ev.get("kind")
     if kind not in SCHEMA:
-        raise ValueError(f"unknown event kind {kind!r}: {ev!r}")
+        raise UnknownKind(f"unknown event kind {kind!r}: {ev!r}")
     missing = SCHEMA[kind] - ev.keys()
     if missing:
         raise ValueError(f"{kind} event missing {sorted(missing)}: {ev!r}")
@@ -166,6 +201,9 @@ class NullTelemetry:
     def counts(self):
         return {}
 
+    def dropped(self):
+        return 0
+
     def flush(self):
         pass
 
@@ -178,22 +216,50 @@ class Telemetry:
 
     ``path=None`` keeps events in memory only (``self.events``) — used by
     bench.py and tests; a path appends JSON lines to that file.
+
+    ``nonblocking=True`` (the serve hot path) hands disk I/O to a daemon
+    writer thread behind a bounded queue (``RMD_TELEMETRY_BUFFER``): a
+    slow disk can never backpressure the scheduler. On overflow the
+    event is dropped and counted (:meth:`dropped`, surfaced as the
+    ``rmd_telemetry_dropped_total`` metric) — losing a trace record
+    under pressure is the contract; losing a request is not.
+
+    ``RMD_TELEMETRY_MAX_MB`` > 0 rotates ``events.jsonl`` once it would
+    exceed that size: the current file moves to ``<path>.1`` (replacing
+    any previous rotation) and writing restarts. Default off — training
+    runs keep one unbroken file.
     """
 
     enabled = True
 
-    def __init__(self, path=None):
+    def __init__(self, path=None, nonblocking=False):
+        from ..utils import env
+
         self.path = os.fspath(path) if path is not None else None
         self.events = []          # in-memory tail (memory-only mode: all)
         self.last_step = None
         self._lock = threading.Lock()
+        self._io_lock = threading.Lock()
         self._buffer = []
         self._fd = None
+        self._size = None
+        self._max_bytes = int(env.get_float("RMD_TELEMETRY_MAX_MB") * 2 ** 20)
         self._phases = {}
         self._step_counters = {}
         self._counts = {}
+        self._dropped = 0
         self._last_step_t = None
         self._ema = None
+        self._nonblocking = bool(nonblocking) and self.path is not None
+        if self._nonblocking:
+            self._capacity = max(1, env.get_int("RMD_TELEMETRY_BUFFER"))
+            self._queue = collections.deque()
+            self._wake = threading.Event()
+            self._stopping = False
+            self._writer = threading.Thread(
+                target=self._writer_loop, name="telemetry-writer",
+                daemon=True)
+            self._writer.start()
 
     # -- event plumbing ----------------------------------------------------
 
@@ -210,6 +276,13 @@ class Telemetry:
             if self.path is None:
                 self.events.append(ev)
                 return ev
+            if self._nonblocking:
+                if len(self._queue) >= self._capacity:
+                    self._dropped += 1
+                else:
+                    self._queue.append(ev)
+                    self._wake.set()
+                return ev
             self._buffer.append(ev)
             if (len(self._buffer) >= _FLUSH_EVERY
                     or kind not in ("step", "device_sync", "compile", "cache")):
@@ -219,22 +292,66 @@ class Telemetry:
     def _flush_locked(self):
         if not self._buffer:
             return
-        if self._fd is None:
-            self._fd = open(self.path, "a")
-        for ev in self._buffer:
-            self._fd.write(json.dumps(ev) + "\n")
-        self._buffer.clear()
-        self._fd.flush()
+        batch, self._buffer = self._buffer, []
+        self._write_batch(batch)
+
+    def _write_batch(self, batch):
+        with self._io_lock:
+            if self._fd is None:
+                self._fd = open(self.path, "a")
+                self._size = os.path.getsize(self.path)
+            data = "".join(json.dumps(ev) + "\n" for ev in batch)
+            if (self._max_bytes > 0 and self._size > 0
+                    and self._size + len(data) > self._max_bytes):
+                self._fd.close()
+                os.replace(self.path, self.path + ".1")
+                self._fd = open(self.path, "a")
+                self._size = 0
+            self._fd.write(data)
+            self._fd.flush()
+            self._size += len(data)
+
+    def _writer_loop(self):
+        while True:
+            self._wake.wait(0.2)
+            self._wake.clear()
+            self._drain()
+            with self._lock:
+                if self._stopping and not self._queue:
+                    return
+
+    def _drain(self):
+        with self._lock:
+            if not self._queue:
+                return
+            batch = list(self._queue)
+            self._queue.clear()
+        self._write_batch(batch)
 
     def flush(self):
+        if self._nonblocking:
+            self._drain()
+            return
         with self._lock:
             if self.path is not None:
                 self._flush_locked()
 
     def close(self):
+        if self._nonblocking:
+            with self._lock:
+                self._stopping = True
+            self._wake.set()
+            self._writer.join(timeout=5.0)
+            self._drain()
+            with self._io_lock:
+                if self._fd is not None:
+                    self._fd.close()
+                    self._fd = None
+            return
         with self._lock:
             if self.path is not None:
                 self._flush_locked()
+        with self._io_lock:
             if self._fd is not None:
                 self._fd.close()
                 self._fd = None
@@ -243,6 +360,12 @@ class Telemetry:
         """Event counts by kind (cheap snapshot, used by bench summaries)."""
         with self._lock:
             return dict(self._counts)
+
+    def dropped(self):
+        """Events shed by the bounded non-blocking buffer (0 in the
+        default blocking mode)."""
+        with self._lock:
+            return self._dropped
 
     # -- phases / steps ----------------------------------------------------
 
@@ -331,9 +454,14 @@ def deactivate():
     return old
 
 
-def create(path=None):
-    """Factory honoring the kill switch: a real sink, or the null one."""
-    return Telemetry(path) if enabled() else NullTelemetry()
+def create(path=None, nonblocking=False):
+    """Factory honoring the kill switch: a real sink, or the null one.
+
+    ``nonblocking=True`` is the serve-path variant: disk writes move to
+    a bounded background writer so ``emit`` never blocks the scheduler.
+    """
+    return Telemetry(path, nonblocking=nonblocking) if enabled() \
+        else NullTelemetry()
 
 
 @contextlib.contextmanager

@@ -1,0 +1,318 @@
+"""In-process metrics registry with Prometheus text exposition.
+
+The serve path needs live scrapeable counters/gauges/histograms without
+adding a dependency, so this is the minimal client: a registry of typed
+metrics, optional label dimensions (children keyed by label values), and
+:meth:`MetricsRegistry.render` producing the Prometheus text format
+(``# HELP`` / ``# TYPE`` / samples, histogram ``_bucket``/``_sum``/
+``_count`` with cumulative ``le`` buckets).
+
+Conventions enforced at registration (and statically by the graftlint
+``telemetry-unregistered-kind`` rule): every metric name matches
+``rmd_<subsystem>_<name>`` — lower-snake, at least three segments, the
+``rmd_`` prefix namespacing the project the way ``RMD_*`` does knobs.
+Counters additionally end in ``_total`` per Prometheus practice.
+
+Thread-safe; increments are a lock + float add, cheap enough for the
+scheduler hot path.
+"""
+
+import re
+import threading
+
+NAME_RE = re.compile(r"^rmd_[a-z0-9]+(?:_[a-z0-9]+)+$")
+LABEL_RE = re.compile(r"^[a-z_][a-z0-9_]*$")
+
+# latency-oriented default buckets (seconds), 1ms .. 10s
+DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                   0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+def _escape(value):
+    return (str(value).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+def _fmt(value):
+    if value == int(value):
+        return str(int(value))
+    return repr(float(value))
+
+
+class _Metric:
+    """Shared parent bookkeeping: labeled children or a single bare
+    child, rendered under one HELP/TYPE header."""
+
+    typ = "untyped"
+
+    def __init__(self, name, doc, labelnames=()):
+        if not NAME_RE.match(name):
+            raise ValueError(
+                f"metric name {name!r} must match rmd_<subsystem>_<name> "
+                f"(lower-snake, rmd_ prefix, >= 3 segments)")
+        for ln in labelnames:
+            if not LABEL_RE.match(ln):
+                raise ValueError(f"bad label name {ln!r} for {name}")
+        self.name = name
+        self.doc = doc
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+        self._children = {}
+        if not self.labelnames:
+            self._children[()] = self._child()
+
+    def labels(self, **labels):
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name} takes labels {self.labelnames}, "
+                f"got {tuple(sorted(labels))}")
+        key = tuple(str(labels[ln]) for ln in self.labelnames)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._children[key] = self._child()
+        return child
+
+    def _bare(self):
+        if self.labelnames:
+            raise ValueError(f"{self.name} needs .labels(...)")
+        return self._children[()]
+
+    def _samples(self):
+        """Yield (suffix, labelpairs, value) for every sample line."""
+        with self._lock:
+            items = sorted(self._children.items())
+        for key, child in items:
+            pairs = list(zip(self.labelnames, key))
+            yield from child.samples(pairs)
+
+    def render(self):
+        lines = [f"# HELP {self.name} {_escape(self.doc)}",
+                 f"# TYPE {self.name} {self.typ}"]
+        for suffix, pairs, value in self._samples():
+            label_s = ""
+            if pairs:
+                label_s = "{" + ",".join(
+                    f'{k}="{_escape(v)}"' for k, v in pairs) + "}"
+            lines.append(f"{self.name}{suffix}{label_s} {_fmt(value)}")
+        return lines
+
+
+class _CounterChild:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, amount=1.0):
+        if amount < 0:
+            raise ValueError("counters only go up")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+    def samples(self, pairs):
+        yield "", pairs, self.value
+
+
+class Counter(_Metric):
+    typ = "counter"
+
+    def __init__(self, name, doc, labelnames=()):
+        if not name.endswith("_total"):
+            raise ValueError(f"counter {name!r} must end in _total")
+        super().__init__(name, doc, labelnames)
+
+    def _child(self):
+        return _CounterChild()
+
+    def inc(self, amount=1.0):
+        self._bare().inc(amount)
+
+    @property
+    def value(self):
+        return self._bare().value
+
+
+class _GaugeChild:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, value):
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount=1.0):
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount=1.0):
+        self.inc(-amount)
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+    def samples(self, pairs):
+        yield "", pairs, self.value
+
+
+class Gauge(_Metric):
+    typ = "gauge"
+
+    def _child(self):
+        return _GaugeChild()
+
+    def set(self, value):
+        self._bare().set(value)
+
+    def inc(self, amount=1.0):
+        self._bare().inc(amount)
+
+    def dec(self, amount=1.0):
+        self._bare().dec(amount)
+
+    @property
+    def value(self):
+        return self._bare().value
+
+
+class _HistogramChild:
+    def __init__(self, buckets):
+        self._lock = threading.Lock()
+        self._buckets = buckets
+        self._counts = [0] * len(buckets)
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value):
+        value = float(value)
+        with self._lock:
+            self._sum += value
+            self._count += 1
+            for i, bound in enumerate(self._buckets):
+                if value <= bound:
+                    self._counts[i] += 1
+                    break  # per-bucket counts; render cumulates
+
+    def samples(self, pairs):
+        with self._lock:
+            counts, total, s = list(self._counts), self._count, self._sum
+        cum = 0
+        for bound, n in zip(self._buckets, counts):
+            cum += n
+            yield "_bucket", pairs + [("le", _fmt(bound))], cum
+        yield "_bucket", pairs + [("le", "+Inf")], total
+        yield "_sum", pairs, s
+        yield "_count", pairs, total
+
+
+class Histogram(_Metric):
+    typ = "histogram"
+
+    def __init__(self, name, doc, labelnames=(), buckets=DEFAULT_BUCKETS):
+        self._buckets = tuple(sorted(float(b) for b in buckets))
+        if not self._buckets:
+            raise ValueError(f"histogram {name!r} needs buckets")
+        super().__init__(name, doc, labelnames)
+
+    def _child(self):
+        return _HistogramChild(self._buckets)
+
+    def observe(self, value):
+        self._bare().observe(value)
+
+
+class MetricsRegistry:
+    """Name-keyed collection of metrics; re-registering an existing
+    name with the same type returns the existing metric (instrumentation
+    points don't coordinate creation order)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics = {}
+
+    def _register(self, cls, name, doc, labelnames, **kwargs):
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if type(existing) is not cls or \
+                        existing.labelnames != tuple(labelnames):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{type(existing).__name__}"
+                        f"{existing.labelnames}")
+                return existing
+            metric = cls(name, doc, labelnames, **kwargs)
+            self._metrics[name] = metric
+            return metric
+
+    def counter(self, name, doc, labelnames=()):
+        return self._register(Counter, name, doc, labelnames)
+
+    def gauge(self, name, doc, labelnames=()):
+        return self._register(Gauge, name, doc, labelnames)
+
+    def histogram(self, name, doc, labelnames=(), buckets=DEFAULT_BUCKETS):
+        return self._register(Histogram, name, doc, labelnames,
+                              buckets=buckets)
+
+    def get_metric(self, name):
+        with self._lock:
+            return self._metrics.get(name)
+
+    def render(self):
+        """The full Prometheus text exposition (text/plain; version
+        0.0.4), metrics in name order."""
+        with self._lock:
+            metrics = [self._metrics[k] for k in sorted(self._metrics)]
+        lines = []
+        for m in metrics:
+            lines.extend(m.render())
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+_default = MetricsRegistry()
+
+
+def registry():
+    """The process-default registry (serve instrumentation target)."""
+    return _default
+
+
+def reset():
+    """Replace the process-default registry (test isolation)."""
+    global _default
+    _default = MetricsRegistry()
+    return _default
+
+
+def parse_text(text):
+    """Parse Prometheus text exposition into ``{name: {labelset: value}}``
+    where ``labelset`` is a sorted tuple of ``(label, value)`` pairs.
+
+    Not a general-purpose parser — just enough for tests and the obs
+    smoke check to assert a scrape round-trips.
+    """
+    out = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = re.match(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+                     r"(?:\{(.*)\})?\s+(\S+)$", line)
+        if not m:
+            raise ValueError(f"unparseable exposition line: {line!r}")
+        name, labels_s, value_s = m.groups()
+        pairs = []
+        if labels_s:
+            for part in re.findall(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]'
+                                   r'|\\.)*)"', labels_s):
+                pairs.append(part)
+        out.setdefault(name, {})[tuple(sorted(pairs))] = float(value_s)
+    return out

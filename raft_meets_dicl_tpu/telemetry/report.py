@@ -8,19 +8,31 @@ milliseconds go, and did anything anomalous happen?*
 """
 
 import json
+import logging
 
-from .core import validate_event
+from .core import NewerSchema, UnknownKind, validate_event
 
 # a compile this many optimizer steps after its stage started is a
 # recompile — the per-stage step build compiles during the first step
 DEFAULT_WARMUP_STEPS = 3
 DEFAULT_SPIKE_FACTOR = 3.0
 
+# one SLO window consuming error budget faster than this sustains is
+# worth a flag (burn 1.0 = exactly at the objective)
+SLO_BURN_FLAG = 1.0
 
-def load_events(path):
+
+def load_events(path, skipped=None):
     """Parse + validate a JSONL file. Returns (events, errors) where
     errors are (line_number, message) for records that fail the schema —
-    a report over a partially-corrupt file still renders what it can."""
+    a report over a partially-corrupt file still renders what it can.
+
+    Forward compatibility: records an *older* reader can't know —
+    unknown event kinds and same-major/newer-minor schema revisions —
+    are warn-and-skipped rather than counted as errors, so old reports
+    read new runs. Pass a list as ``skipped`` to collect their
+    (line_number, message) pairs; they are logged either way.
+    """
     events, errors = [], []
     with open(path) as fd:
         for n, line in enumerate(fd, 1):
@@ -29,6 +41,11 @@ def load_events(path):
                 continue
             try:
                 events.append(validate_event(json.loads(line)))
+            except (UnknownKind, NewerSchema) as e:
+                logging.warning(f"{path}:{n}: skipping record from a "
+                                f"newer producer: {e}")
+                if skipped is not None:
+                    skipped.append((n, str(e)))
             except (json.JSONDecodeError, ValueError) as e:
                 errors.append((n, str(e)))
     return events, errors
@@ -173,6 +190,30 @@ def find_anomalies(events, warmup_steps=DEFAULT_WARMUP_STEPS,
                 f"AOT fallback to cold JIT: "
                 f"{e.get('program', '?')}[{e.get('model', '?')}]"
                 + (f" ({e['reason']})" if "reason" in e else ""))
+
+    # SLO burn: any window that consumed error budget faster than
+    # sustainable; paired with the trace tail so a burning class is
+    # attributable to a phase (queue-dominated = load/batching, not
+    # the model)
+    slo = slo_stats(events)
+    if slo:
+        for klass, s in slo["classes"].items():
+            if s["worst_burn_rate"] > SLO_BURN_FLAG:
+                flags.append(
+                    f"SLO burn: class '{klass or 'default'}' hit burn "
+                    f"rate {s['worst_burn_rate']:.2f} "
+                    f"(target {s['target_ms']:.0f} ms, latest attainment "
+                    f"{s['attainment'] * 100:.1f}%)")
+    traces = trace_stats(events)
+    if traces and traces["tail"]["queue_dominated"]:
+        tail = traces["tail"]
+        flags.append(
+            f"queue-dominated tail: slowest decile "
+            f"({tail['count']} requests, mean "
+            f"{tail['total_s'] * 1e3:.1f} ms) spends most of its time "
+            f"queued ({tail['phases_s'].get('queue', 0.0) * 1e3:.1f} ms "
+            f"mean) — add capacity or shrink max-wait, the model is "
+            f"not the bottleneck")
 
     for e in events:
         if e["kind"] == "nonfinite":
@@ -378,6 +419,75 @@ def serve_stats(events):
     }
 
 
+def slo_stats(events):
+    """Per-class SLO window summaries from the periodic ``slo`` events: the
+    *latest* window per class (the current state) plus the worst burn
+    rate seen across the run."""
+    latest, worst = {}, {}
+    for e in events:
+        if e["kind"] != "slo":
+            continue
+        k = e.get("klass", "")
+        latest[k] = e
+        if e["burn_rate"] > worst.get(k, {}).get("burn_rate", -1.0):
+            worst[k] = e
+    if not latest:
+        return None
+    return {
+        "classes": {k: {
+            "target_ms": e["target_ms"],
+            "objective": e.get("objective"),
+            "window_s": e.get("window_s"),
+            "good": e.get("good", 0),
+            "bad": e.get("bad", 0),
+            "attainment": e["attainment"],
+            "burn_rate": e["burn_rate"],
+            "worst_burn_rate": worst[k]["burn_rate"],
+        } for k, e in sorted(latest.items())},
+    }
+
+
+def trace_stats(events, decile=0.9):
+    """Aggregate per-request ``trace`` events: per-class counts and the
+    slowest-decile critical-path phase breakdown (mean ms per phase,
+    dominant phase named) — the offline twin of TraceSummary.tail()."""
+    requests = [e for e in events
+                if e["kind"] == "trace" and e.get("event") == "request"]
+    batches = [e for e in events
+               if e["kind"] == "trace" and e.get("event") == "batch"]
+    if not requests:
+        return None
+    ranked = sorted(requests, key=lambda e: e.get("total", 0.0))
+    cut = max(1, len(ranked) - int(len(ranked) * decile))
+    slow = ranked[-cut:]
+    phases = {}
+    for e in slow:
+        for name, secs in (e.get("phases") or {}).items():
+            phases.setdefault(name, []).append(secs)
+    mean = {name: sum(vals) / len(vals) for name, vals in phases.items()}
+    dominant = max(mean, key=mean.get) if mean else None
+    classes = {}
+    for e in requests:
+        k = e.get("klass") or ""
+        classes.setdefault(k, []).append(e.get("total", 0.0))
+    return {
+        "requests": len(requests),
+        "batches": len(batches),
+        "classes": {k: {
+            "count": len(v),
+            "p50_s": _percentile(sorted(v), 0.50),
+            "p99_s": _percentile(sorted(v), 0.99),
+        } for k, v in sorted(classes.items())},
+        "tail": {
+            "count": len(slow),
+            "total_s": sum(e.get("total", 0.0) for e in slow) / len(slow),
+            "phases_s": {k: mean[k] for k in sorted(mean)},
+            "dominant": dominant,
+            "queue_dominated": dominant == "queue",
+        },
+    }
+
+
 def sharding_stats(events):
     """Per-stage SPMD placement summaries from ``sharding`` events: mesh
     shape and the per-chip vs. replicated byte accounting the partitioner
@@ -549,6 +659,40 @@ def render(events, errors=(), warmup_steps=DEFAULT_WARMUP_STEPS,
                 f"  warm pool {w['model']}[{w['bucket']}] ({w['wire']}"
                 f"{rung}): {w['compiles']} compiles, {w['aot_hits']} AOT "
                 f"hits, {w['aot_saves']} AOT saves")
+
+    traces = trace_stats(events)
+    if traces:
+        lines.append("")
+        lines.append("== tracing ==")
+        lines.append(
+            f"traced: {traces['requests']} requests in "
+            f"{traces['batches']} batches")
+        for k, c in sorted(traces["classes"].items()):
+            lines.append(
+                f"  class {k or 'default':<9} {c['count']:>4d} requests: "
+                f"p50 {c['p50_s'] * 1e3:.1f} ms, "
+                f"p99 {c['p99_s'] * 1e3:.1f} ms")
+        tail = traces["tail"]
+        breakdown = ", ".join(
+            f"{name} {secs * 1e3:.1f} ms"
+            for name, secs in tail["phases_s"].items())
+        lines.append(
+            f"slowest decile ({tail['count']} requests, mean "
+            f"{tail['total_s'] * 1e3:.1f} ms): {breakdown or '-'} "
+            f"[dominant: {tail['dominant'] or '-'}]")
+
+    slo = slo_stats(events)
+    if slo:
+        lines.append("")
+        lines.append("== slo ==")
+        lines.append(f"{'class':<10} {'target':>9} {'attain':>8} "
+                     f"{'burn':>7} {'worst':>7} {'window':>12}")
+        for k, s in slo["classes"].items():
+            window = f"{s['good']}+{s['bad']}/{s['window_s']:.0f}s"
+            lines.append(
+                f"{k or 'default':<10} {s['target_ms']:>7.1f}ms "
+                f"{s['attainment'] * 100:>7.1f}% {s['burn_rate']:>7.2f} "
+                f"{s['worst_burn_rate']:>7.2f} {window:>12}")
 
     aot = aot_stats(events)
     if aot["boot"] or aot["programs"]:

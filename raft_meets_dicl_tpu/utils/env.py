@@ -64,6 +64,12 @@ KNOBS = dict([
     _k("RMD_FINITE_CHECK_EVERY", "int", 10,
        "amortized cadence (steps) of the device finiteness fetch / "
        "pipeline-drain sample", "telemetry"),
+    _k("RMD_TELEMETRY_BUFFER", "int", 4096,
+       "bounded event-queue capacity of the non-blocking serve sink; "
+       "overflow drops events and counts them", "telemetry"),
+    _k("RMD_TELEMETRY_MAX_MB", "float", 0.0,
+       "rotate events.jsonl to <path>.1 past this size in MiB (0 = "
+       "never rotate)", "telemetry"),
     # -- input pipeline ----------------------------------------------------
     _k("RMD_WIRE_FORMAT", "str", None,
        "host-to-device wire format preset (f32 | bf16 | u8); CLI "
@@ -159,6 +165,26 @@ KNOBS = dict([
     _k("RMD_LADDER_THRESHOLD", "float", 0.1,
        "flow-delta norm (coarse-grid px) below which the balanced class "
        "stops escalating rungs", "serve"),
+    _k("RMD_METRICS_PORT", "int", 0,
+       "serve observability HTTP port (/metrics, /healthz, /statusz, "
+       "/profilez); 0 = off; CLI --metrics-port wins", "serve"),
+    _k("RMD_SLO_FAST_MS", "float", 0.0,
+       "end-to-end latency SLO target (ms) for the fast ladder class "
+       "(0 = untracked)", "serve"),
+    _k("RMD_SLO_BALANCED_MS", "float", 0.0,
+       "end-to-end latency SLO target (ms) for the balanced ladder "
+       "class (0 = untracked)", "serve"),
+    _k("RMD_SLO_QUALITY_MS", "float", 0.0,
+       "end-to-end latency SLO target (ms) for the quality ladder "
+       "class (0 = untracked)", "serve"),
+    _k("RMD_SLO_DEFAULT_MS", "float", 0.0,
+       "latency SLO target (ms) for ladderless requests and classes "
+       "without their own RMD_SLO_* target (0 = untracked)", "serve"),
+    _k("RMD_SLO_OBJECTIVE", "float", 0.99,
+       "SLO attainment objective; burn_rate = (1-attainment)/"
+       "(1-objective), >1 means the window misses it", "serve"),
+    _k("RMD_SLO_WINDOW_S", "float", 60.0,
+       "rolling SLO burn-rate window (seconds)", "serve"),
     # -- fault injection / harness -----------------------------------------
     _k("RMD_FAULT", "str", "",
        "deterministic fault injection spec (testing.faults)", "faults"),

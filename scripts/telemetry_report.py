@@ -54,9 +54,16 @@ def main(argv=None):
                     help="exit non-zero on schema errors or anomalies")
     args = ap.parse_args(argv)
 
-    events, errors = report.load_events(resolve(args.path))
+    skipped = []
+    events, errors = report.load_events(resolve(args.path),
+                                        skipped=skipped)
     print(report.render(events, errors, warmup_steps=args.warmup_steps,
                         spike_factor=args.spike_factor))
+    if skipped:
+        # forward compat, not corruption: records from a newer producer
+        # (unknown kind / newer schema minor) — never fails --strict
+        print(f"\nskipped {len(skipped)} record(s) from a newer producer "
+              f"(first: line {skipped[0][0]}: {skipped[0][1]})")
 
     flags = report.find_anomalies(events, warmup_steps=args.warmup_steps,
                                   spike_factor=args.spike_factor)
