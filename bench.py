@@ -26,6 +26,25 @@ from raft_meets_dicl_tpu.utils import env
 BASELINE_PAIRS_PER_SEC_PER_CHIP = 400.0 / 32.0
 
 
+def _emit(result):
+    """Print one cumulative JSON result line with the goodput breakdown
+    attached: every BENCH_* line carries the wall-clock ledger
+    (productive vs compile vs data-starved vs ... seconds) so a slow
+    bench is attributable without re-running under a profiler."""
+    from raft_meets_dicl_tpu.telemetry import goodput
+
+    ledger = goodput.get()
+    if ledger.enabled:
+        snap = ledger.snapshot()
+        result["goodput"] = {
+            "total_s": snap["total"],
+            "goodput": snap["goodput"],
+            "classes_s": snap["classes"],
+        }
+    _emit(result)
+    return result
+
+
 def _measure(model_cfg, loss_cfg, batch, height, width, model_args, steps,
              nonfinite=None):
     """One synthetic training-step throughput measurement; all device
@@ -197,7 +216,7 @@ def _bench_input():
             "collate_ms": round(collate_ms, 2),
             "wire_mb_per_step": round(wire_mb, 3),
         }
-        print(json.dumps(result), flush=True)
+        _emit(result)
     return result
 
 
@@ -332,7 +351,7 @@ def _bench_eval():
     # (a) baseline: batch 1, no bucketing — one compile per distinct shape
     evaluation._EVAL_FN_CACHE.clear()
     result["baseline_b1"] = sweep(None, 1, label="baseline-b1")
-    print(json.dumps(result), flush=True)
+    _emit(result)
 
     # (b) bucketed: grouped full batches, remainder padding, warm buckets
     evaluation._EVAL_FN_CACHE.clear()
@@ -347,7 +366,7 @@ def _bench_eval():
     result["epe_rel_diff"] = round(
         abs(result["bucketed"]["mean_epe"] - result["baseline_b1"]["mean_epe"])
         / max(abs(result["baseline_b1"]["mean_epe"]), 1e-9), 6)
-    print(json.dumps(result), flush=True)
+    _emit(result)
     return result
 
 
@@ -486,7 +505,7 @@ def _bench_serve():
     programs.reset()
     evaluation._EVAL_FN_CACHE.clear()
     result["cold"] = run_phase()
-    print(json.dumps(result), flush=True)
+    _emit(result)
 
     # phases 2+3 replay the compile work against a fresh AOT store; skip
     # explicitly when the cold phase already ate the budget rather than
@@ -496,7 +515,7 @@ def _bench_serve():
         result["prebuild_skipped"] = f"budget ({elapsed:.0f}s elapsed)"
         print(f"SKIPPED prebuild/warm-replica: budget "
               f"({elapsed:.0f}s of {budget_s:.0f}s used)", flush=True)
-        print(json.dumps(result), flush=True)
+        _emit(result)
         return result
 
     tmp = tempfile.mkdtemp(prefix="bench-serve-aot-")
@@ -517,7 +536,7 @@ def _bench_serve():
             "aot_saves": sum(o["aot_saves"] for o in outcomes),
             "seconds": round(time.perf_counter() - t0, 3),
         }
-        print(json.dumps(result), flush=True)
+        _emit(result)
 
         # phase 3: fresh replica against the exported store — prepared and
         # serving the full stream with zero compiles
@@ -527,7 +546,7 @@ def _bench_serve():
         result["zero_compile_serve"] = (
             result["warm_replica"]["warm_pool"]["compiles"] == 0
             and result["warm_replica"]["serve_compiles"] == 0)
-        print(json.dumps(result), flush=True)
+        _emit(result)
     finally:
         programs.disable_aot()
         shutil.rmtree(tmp, ignore_errors=True)
@@ -615,7 +634,7 @@ def _bench_ladder():
         if result["families"] and elapsed > budget_s * 0.8:
             result["families"][name] = {
                 "skipped": f"budget ({elapsed:.0f}s elapsed)"}
-            print(json.dumps(result), flush=True)
+            _emit(result)
             continue
         spec = models.load({
             "name": name, "id": f"bench-ladder-{name}",
@@ -698,7 +717,7 @@ def _bench_ladder():
                     4)},
         }
         result["families"][name] = fam
-        print(json.dumps(result), flush=True)
+        _emit(result)
 
 
 def _bench_dicl():
@@ -770,7 +789,7 @@ def _bench_dicl():
         "fused_fwd_bwd": timed(
             lambda fs: sample_all_grad(sample_window_fused, fs), fmap2),
     }
-    print(json.dumps(result), flush=True)
+    _emit(result)
 
     # matching nets: reference per-level loop vs the level-batched call,
     # on identical parameters (bf16 matching like the mixed policy)
@@ -797,7 +816,7 @@ def _bench_dicl():
             "loop_fwd_bwd": timed(lambda vv: fwd_bwd(vv, False), v),
             "batched_fwd_bwd": timed(lambda vv: fwd_bwd(vv, True), v),
         }
-        print(json.dumps(result), flush=True)
+        _emit(result)
 
     # per-iteration matching-volume bytes (bf16 fast path vs f32 stacked
     # reference): window + f1 in matching dtype vs the 2C stacked volume
@@ -809,7 +828,7 @@ def _bench_dicl():
     }
     if tele.enabled:
         result["telemetry_events"] = tele.counts()
-    print(json.dumps(result), flush=True)
+    _emit(result)
     return result
 
 
@@ -942,12 +961,12 @@ def _bench_spmd():
         elapsed = time.monotonic() - t_start
         if result and elapsed + 1.5 * max(slowest, 30.0) > budget_s:
             result[f"{key}_skipped"] = f"budget ({elapsed:.0f}s elapsed)"
-            print(json.dumps(result), flush=True)
+            _emit(result)
             continue
         t0 = time.monotonic()
         result[key] = measure(mesh_spec, acc)
         slowest = max(slowest, time.monotonic() - t0)
-        print(json.dumps(result), flush=True)
+        _emit(result)
 
     base = result.get("mesh_8x1")
     for key in ("mesh_4x2", "mesh_2x4"):
@@ -960,7 +979,7 @@ def _bench_spmd():
                   1e-9), 4)
         result[f"{key}_loss_rel_diff"] = round(
             abs(m["loss"] - base["loss"]) / max(abs(base["loss"]), 1e-9), 6)
-    print(json.dumps(result), flush=True)
+    _emit(result)
     return result
 
 
@@ -990,6 +1009,10 @@ def _bench_compile_child():
     enable_persistent_cache()
     programs.enable_aot()
     telemetry.activate(telemetry.create())
+    # wall-clock ledger from process start: the emitted line's goodput
+    # block is the compile-vs-productive split the scenarios compare
+    from raft_meets_dicl_tpu.telemetry import goodput
+    goodput.activate()
 
     cpu = jax.default_backend() == "cpu"
     if cpu:
@@ -1052,7 +1075,7 @@ def _bench_compile_child():
     tts = t_end - t0
 
     tele = telemetry.get()
-    print(json.dumps({
+    _emit({
         "mode": mode,
         "time_to_first_step_s": round(tts, 3),
         "setup_s": round(t0 - t_boot, 3),
@@ -1065,7 +1088,7 @@ def _bench_compile_child():
         "aot_hits": prog.aot_hits,
         "aot_saves": prog.aot_saves,
         "aot_fallbacks": prog.aot_fallbacks,
-    }), flush=True)
+    })
 
 
 def _bench_compile():
@@ -1116,7 +1139,7 @@ def _bench_compile():
     for mode in ("train", "eval"):
         m = {}
         m["cold"] = run_child(mode, "cold")
-        print(json.dumps(result | {mode: m}), flush=True)
+        _emit(result | {mode: m})
         run_child(mode, "populate")  # fills compile cache + AOT store
         m["warm_cache"] = run_child(mode, "warm_cache")
         m["aot"] = run_child(mode, "aot")
@@ -1125,8 +1148,16 @@ def _bench_compile():
             cold / max(m["warm_cache"]["time_to_first_step_s"], 1e-9), 2)
         m["speedup_aot"] = round(
             cold / max(m["aot"]["time_to_first_step_s"], 1e-9), 2)
+        # compile-vs-productive per scenario, read off the child's
+        # goodput ledger (one classifier for every bench, rather than
+        # this bench's old ad-hoc compile_s/total_s arithmetic)
+        for scen in ("cold", "warm_cache", "aot"):
+            gp = m[scen].get("goodput")
+            if gp and gp.get("total_s"):
+                m[scen]["compile_share"] = round(
+                    gp["classes_s"].get("compile", 0.0) / gp["total_s"], 4)
         result[mode] = m
-        print(json.dumps(result), flush=True)
+        _emit(result)
     return result
 
 
@@ -1163,7 +1194,7 @@ def _bench_fault():
     result["plain_pairs_per_sec"] = round(plain, 3)
     if psum is not None:
         result["plain_step_ms"] = psum["step_ms_mean"]
-    print(json.dumps(result), flush=True)
+    _emit(result)
 
     guarded, _, gsum = _measure(model_cfg, loss_cfg, batch, height, width,
                                 {"iterations": iters}, steps,
@@ -1173,7 +1204,7 @@ def _bench_fault():
         result["guarded_step_ms"] = gsum["step_ms_mean"]
     result["overhead_pct"] = round((plain / guarded - 1.0) * 100, 2) \
         if guarded else None
-    print(json.dumps(result), flush=True)
+    _emit(result)
     return result
 
 
@@ -1182,6 +1213,12 @@ def main():
         # one cold-start scenario delegated by the BENCH_COMPILE parent
         _bench_compile_child()
         return
+
+    # every BENCH_* mode runs on a goodput ledger from here: telemetry
+    # compile/checkpoint/eval events are classified as they are emitted
+    # and _emit attaches the breakdown to each JSON line
+    from raft_meets_dicl_tpu.telemetry import goodput
+    goodput.activate()
 
     if os.environ.get("BENCH_COMPILE", "0") != "0":
         # cold vs persistent-cache-warm vs AOT-warm time-to-first-step
@@ -1298,7 +1335,7 @@ def main():
             f"budget ({elapsed:.0f}s elapsed, est {need:.0f}s)")
         print(f"SKIPPED {tag}: budget ({elapsed:.0f}s of {budget_s:.0f}s "
               f"used, est {need:.0f}s)", flush=True)
-        print(json.dumps(result), flush=True)
+        _emit(result)
         return False
 
     if jax.default_backend() == "cpu":
@@ -1331,7 +1368,7 @@ def main():
     # publish the primary metric immediately: the flagship measurement
     # below adds a cold ~10 min compile, and a harness timeout must not
     # lose this line (consumers read the LAST json line printed)
-    print(json.dumps(result), flush=True)
+    _emit(result)
 
     if os.environ.get("BENCH_FLAGSHIP", "1") != "0" \
             and budget_allows("ctf_l3", 3.0):
@@ -1358,7 +1395,7 @@ def main():
         except Exception as e:  # noqa: BLE001 - report, don't lose the line
             result["ctf_l3_error"] = f"{type(e).__name__}: {str(e)[:120]}"
 
-        print(json.dumps(result), flush=True)
+        _emit(result)
 
     if os.environ.get("BENCH_ZOO", "1") != "0":
         # one throughput line per model family at its reference training
@@ -1426,7 +1463,7 @@ def main():
                 except Exception as e:  # noqa: BLE001
                     result[f"{name}_error"] = (
                         f"{type(e).__name__}: {str(e)[:120]}")
-            print(json.dumps(result), flush=True)
+            _emit(result)
 
 
 if __name__ == "__main__":

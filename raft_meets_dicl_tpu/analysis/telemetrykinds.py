@@ -21,6 +21,12 @@ Only string-literal names are checked (a computed kind is the schema's
 validate-at-runtime problem); non-telemetry ``.emit``/``.histogram``
 receivers with non-literal args never match. Baseline-able like every
 rule.
+
+A third check, **sidecar-route (project)**, holds the HTTP surface to
+the same documentation contract as the knob registry: every route in
+``telemetry.sidecar.ROUTES`` (the one tuple both the serve and train
+sidecars dispatch on) must appear in the README's observability table —
+an endpoint nobody can discover is dead weight on a debug port.
 """
 
 import ast
@@ -108,10 +114,63 @@ def check(module):
     return findings
 
 
+SIDECAR_RULE = "sidecar-route"
+SIDECAR_MODULE = "raft_meets_dicl_tpu/telemetry/sidecar.py"
+
+
+def _sidecar_routes(module):
+    """(lineno, [route literals]) from the module-level ``ROUTES = (...)``
+    assignment, else None."""
+    for node in module.tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "ROUTES"
+                   for t in node.targets):
+            continue
+        if isinstance(node.value, (ast.Tuple, ast.List)):
+            routes = [_literal(el) for el in node.value.elts]
+            return node.lineno, [r for r in routes if r]
+    return None
+
+
+def check_sidecar_routes(ctx):
+    """Every route the sidecar serves must appear in README.md (the
+    observability table documents the endpoint surface)."""
+    module = next((m for m in ctx.modules if m.rel == SIDECAR_MODULE), None)
+    if module is None:
+        # partial --root runs don't cover the sidecar; nothing to hold
+        return []
+    parsed = _sidecar_routes(module)
+    if parsed is None:
+        return [Finding(
+            rule=SIDECAR_RULE, path=SIDECAR_MODULE, line=1,
+            message="telemetry/sidecar.py has no module-level ROUTES "
+                    "tuple of string literals; the sidecar-route rule "
+                    "anchors the documented endpoint surface on it")]
+    lineno, routes = parsed
+    readme = ctx.root / "README.md"
+    if not readme.exists():
+        return [Finding(rule=SIDECAR_RULE, path="README.md", line=1,
+                        message="README.md missing")]
+    text = readme.read_text()
+    return [
+        Finding(
+            rule=SIDECAR_RULE, path=SIDECAR_MODULE, line=lineno,
+            message=f"sidecar route {route!r} is not documented in "
+                    f"README.md; add it to the observability endpoint "
+                    f"table (or drop the route)")
+        for route in routes if route not in text
+    ]
+
+
 RULES = [
     Rule(name=RULE,
          doc="emit() kinds must be declared in telemetry.core.SCHEMA; "
              "metric names must match rmd_<subsystem>_<name> (counters "
              "ending _total)",
          check=check),
+    Rule(name=SIDECAR_RULE,
+         doc="every route in telemetry.sidecar.ROUTES must appear in "
+             "the README observability table",
+         project=check_sidecar_routes),
 ]

@@ -266,6 +266,18 @@ def _train(args):
         if tele.path:
             logging.info(f"writing telemetry events to '{tele.path}'")
 
+    # goodput ledger + flight recorder ride the event stream (taps in
+    # Telemetry.emit), so they activate right after the sink: the resume
+    # event below must reach the ledger for replay accounting
+    from ..telemetry import blackbox, goodput
+
+    if tele.enabled and utils.env.get_bool("RMD_GOODPUT"):
+        goodput.activate()
+    if tele.enabled:
+        blackbox.activate(
+            capacity=max(1, utils.env.get_int("RMD_BLACKBOX_STEPS")),
+            registry=telemetry.metrics.registry())
+
     # boot configuration event: the effective compile-cache and AOT
     # program directories (instead of silently defaulting) plus the
     # prefetch knob — the first thing a cold-start post-mortem needs
@@ -324,7 +336,7 @@ def _train(args):
     with open(path_out / "model.txt", "w") as fd:
         fd.write(repr(model.model.module))
 
-    utils.config.store(path_config, {
+    run_config = {
         "timestamp": timestamp.isoformat(),
         "commit": utils.vcs.get_git_head_hash(),
         "comment": args.comment if args.comment else "",
@@ -335,7 +347,9 @@ def _train(args):
         "strategy": strat.get_config(),
         "inspect": inspc.get_config(),
         "environment": env.get_config(),
-    })
+    }
+    utils.config.store(path_config, run_config)
+    blackbox.get().config = run_config
 
     # devices / mesh: --mesh > RMD_MESH > env 'parallel' section. Default
     # is the 1-D data mesh over every selected device (pure batch
@@ -487,15 +501,42 @@ def _train(args):
               commit=utils.vcs.get_git_head_hash(),
               comment=args.comment or "")
 
+    # trainer observability sidecar: --metrics-port > RMD_TRAIN_METRICS_PORT;
+    # serves /metrics, /healthz, /statusz, /profilez off the shared
+    # telemetry.sidecar server (port 0 picks an ephemeral port)
+    mport = getattr(args, "metrics_port", None)
+    if mport is None and utils.env.is_set("RMD_TRAIN_METRICS_PORT"):
+        mport = utils.env.get_int("RMD_TRAIN_METRICS_PORT")
+    observer = None
+    if mport is not None and primary:
+        from ..telemetry import sidecar
+
+        observer = sidecar.train_observer(tctx, mport, sink=tele,
+                                          ledger=goodput.get())
+        logging.info(f"trainer observability sidecar: {observer.url}")
+
     # preemption safety: SIGTERM/SIGINT finish the in-flight step, write
     # an emergency checkpoint, and return cleanly (--resume auto resumes)
     tctx.install_signal_handlers()
 
     try:
         tctx.run(args.start_stage, args.start_epoch, chkpt)
+    except Exception:
+        # crash postmortem: the nonfinite/preempt paths dump their own
+        # bundle first (dump is once-per-process, first reason wins)
+        blackbox.get().dump(path_out, "crash", tele=tele, step=tctx.step)
+        raise
     finally:
         if profile_dir:
             jax.profiler.stop_trace()
+        if observer is not None:
+            observer.close()
+        ledger = goodput.get()
+        if ledger.enabled:
+            ledger.close()
+            ledger.emit_event(tele, final=True, step=tctx.step)
+        goodput.deactivate()
+        blackbox.deactivate()
         tele.emit("run_end")
         tele.close()
 

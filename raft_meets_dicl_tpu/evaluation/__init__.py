@@ -538,11 +538,45 @@ def evaluate(model, variables, data, model_args=None, show_progress=True,
                 meta=meta[b],
             )
 
+    # per-bucket liveness: long bucketed sweeps were silent between
+    # warmup and the final ``eval`` event — emit one ``steptrace``
+    # progress event (scope="eval") per finished bucket, reusing the
+    # StepTrace phase vocabulary so /statusz and the report can show a
+    # sweep heartbeat without per-batch events
+    tele = telemetry.get()
+    progress = {"bucket": None, "batches": 0, "samples": 0,
+                "phases": {}, "t": time.perf_counter()}
+
+    def bucket_progress(next_bucket):
+        if stats is None or not tele.enabled:
+            progress["bucket"] = next_bucket
+            return
+        if (progress["bucket"] is not None
+                and stats.batches > progress["batches"]):
+            now = time.perf_counter()
+            phases = {k: round(v - progress["phases"].get(k, 0.0), 6)
+                      for k, v in stats.phases.items()
+                      if v - progress["phases"].get(k, 0.0) > 0}
+            tele.emit("steptrace", scope="eval", name=stats.name,
+                      step=stats.batches, bucket=progress["bucket"],
+                      window=stats.batches - progress["batches"],
+                      samples=stats.samples - progress["samples"],
+                      phases=phases, total=round(now - progress["t"], 6))
+            progress["t"] = now
+        progress["bucket"] = next_bucket
+        progress["batches"] = stats.batches
+        progress["samples"] = stats.samples
+        progress["phases"] = dict(stats.phases)
+
     pending = None
     for item in data:
+        bucket = f"{item[0].shape[1]}x{item[0].shape[2]}"
+        if bucket != progress["bucket"]:
+            bucket_progress(bucket)
         dispatched = dispatch(item)
         if pending is not None:
             yield from drain(pending)
         pending = dispatched
     if pending is not None:
         yield from drain(pending)
+    bucket_progress(None)

@@ -104,6 +104,12 @@ def main():
     train.add_argument("--no-telemetry", action="store_true",
                        help="disable run telemetry "
                             "(equivalent to RMD_TELEMETRY=0)")
+    train.add_argument("--metrics-port", type=int, metavar="PORT",
+                       help="trainer observability HTTP port on "
+                            "127.0.0.1: /metrics (Prometheus text), "
+                            "/healthz, /statusz, /profilez?seconds=N; "
+                            "0 picks an ephemeral port (also: "
+                            "RMD_TRAIN_METRICS_PORT) [default: off]")
     train.add_argument("--wire-format", choices=["f32", "bf16", "u8"],
                        help="host->device batch wire format: compact image "
                             "dtype + on-device normalization (also: "

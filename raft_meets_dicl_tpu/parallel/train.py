@@ -269,6 +269,11 @@ def make_train_step(model, loss_fn, tx, mesh=None, loss_args=None,
             "final": final,
             "finite": finite,
             "nonfinite_count": nf_count,
+            # in-step global norms: two elementwise reductions fused
+            # into the compiled step, fetched host-side only at the
+            # amortized finite-check cadence (observability gauges)
+            "grad_norm": optax.global_norm(grads),
+            "update_norm": optax.global_norm(updates),
         }
         if with_grads:
             aux["grads"] = grads
@@ -298,7 +303,8 @@ def make_train_step(model, loss_fn, tx, mesh=None, loss_args=None,
     data = partition.data_sharding(mesh)
     state_in = state_sharding if state_sharding is not None else repl
     aux_shardings = {"loss": repl, "final": data, "finite": repl,
-                     "nonfinite_count": repl}
+                     "nonfinite_count": repl, "grad_norm": repl,
+                     "update_norm": repl}
     if with_grads:
         # gradients shard exactly like the parameters they differentiate
         aux_shardings["grads"] = (state_sharding.params

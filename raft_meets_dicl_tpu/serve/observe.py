@@ -1,7 +1,8 @@
 """Live observability HTTP plane for one serve replica.
 
-A tiny stdlib HTTP server (daemon thread, no dependency) the fleet
-router and operators scrape:
+The HTTP server itself (routes, daemon thread, profile capture) is the
+shared sidecar in :mod:`..telemetry.sidecar` — the trainer binds the
+same server — and this module keeps only the serve-side observer:
 
 - ``/metrics`` — Prometheus text exposition of the ``rmd_*`` registry
   (telemetry.metrics), with the scrape-time gauges (queue depth,
@@ -23,20 +24,20 @@ The server binds ``127.0.0.1`` (an observability sidecar, not the
 serving API) and ``port=0`` picks an ephemeral port (tests).
 """
 
-import json
-import tempfile
 import threading
-import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from urllib.parse import parse_qs, urlparse
 
 from ..telemetry import metrics as metrics_mod
+from ..telemetry import sidecar
+from ..telemetry.sidecar import (  # noqa: F401 - back-compat re-exports
+    DEFAULT_PROFILE_S,
+    MAX_PROFILE_S,
+    STALE_HEARTBEAT_S,
+    ProfileBusy,
+)
 
-# liveness: the dispatch loop wakes at least every second
-# (scheduler._HEARTBEAT_WAKE_S); 10x that margin tolerates a loaded host
-STALE_HEARTBEAT_S = 10.0
-MAX_PROFILE_S = 60.0
-DEFAULT_PROFILE_S = 3.0
+# the handler/server formerly defined here; kept importable under the
+# old names so callers and tests bind serve observers unchanged
+_Handler = sidecar.Handler
 
 
 class Observer:
@@ -129,91 +130,15 @@ class Observer:
         """Capture ``seconds`` of jax profiler trace; returns the
         directory holding the capture. Single-flight: a second request
         while one runs gets a 409."""
-        seconds = min(max(float(str(seconds)), 0.1), MAX_PROFILE_S)
-        if not self._profile_lock.acquire(blocking=False):
-            raise ProfileBusy("a profile capture is already running")
-        try:
-            import jax
-
-            out = tempfile.mkdtemp(prefix="rmd-profilez-")
-            jax.profiler.start_trace(out)
-            time.sleep(seconds)
-            jax.profiler.stop_trace()
-            return {"dir": out, "seconds": seconds}
-        finally:
-            self._profile_lock.release()
+        return sidecar.capture_profile(self._profile_lock, seconds)
 
 
-class ProfileBusy(RuntimeError):
-    pass
-
-
-class _Handler(BaseHTTPRequestHandler):
-    observer = None  # bound by serve_observer via subclass attribute
-
-    def log_message(self, fmt, *args):  # silence per-request stderr spam
-        pass
-
-    def _send(self, code, body, content_type="application/json"):
-        data = body if isinstance(body, bytes) else body.encode()
-        self.send_response(code)
-        self.send_header("Content-Type", content_type)
-        self.send_header("Content-Length", str(len(data)))
-        self.end_headers()
-        self.wfile.write(data)
-
-    def _send_json(self, code, payload):
-        self._send(code, json.dumps(payload, indent=2) + "\n")
-
-    def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler contract
-        url = urlparse(self.path)
-        obs = self.observer
-        try:
-            if url.path == "/metrics":
-                self._send(200, obs.metrics_text(),
-                           "text/plain; version=0.0.4; charset=utf-8")
-            elif url.path == "/healthz":
-                payload, code = obs.health()
-                self._send_json(code, payload)
-            elif url.path == "/statusz":
-                self._send_json(200, obs.status())
-            elif url.path == "/profilez":
-                qs = parse_qs(url.query)
-                seconds = qs.get("seconds", [DEFAULT_PROFILE_S])[0]
-                self._send_json(200, obs.profile(seconds))
-            else:
-                self._send_json(404, {"error": f"no route {url.path}"})
-        except ProfileBusy as e:
-            self._send_json(409, {"error": str(e)})
-        except Exception as e:  # noqa: BLE001 - scrape must not kill serve
-            self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
-
-
-class ObserverServer:
-    """The bound HTTP server + its daemon thread."""
+class ObserverServer(sidecar.SidecarServer):
+    """The bound HTTP server + its daemon thread (shared sidecar)."""
 
     def __init__(self, observer, port, host="127.0.0.1"):
-        handler = type("BoundHandler", (_Handler,), {"observer": observer})
-        self.observer = observer
-        self.httpd = ThreadingHTTPServer((host, int(port)), handler)  # graftlint: disable=host-sync -- TCP port number, not a device value
-        self.httpd.daemon_threads = True
-        self.port = self.httpd.server_address[1]
-        self._thread = threading.Thread(
-            target=self.httpd.serve_forever, name="serve-observe",
-            daemon=True)
-
-    def start(self):
-        self._thread.start()
-        return self
-
-    def close(self):
-        self.httpd.shutdown()
-        self.httpd.server_close()
-        self._thread.join(timeout=5.0)
-
-    @property
-    def url(self):
-        return f"http://{self.httpd.server_address[0]}:{self.port}"
+        super().__init__(observer, port, host=host,
+                         thread_name="serve-observe")
 
 
 def serve_observer(session, scheduler, port, sink=None, registry=None):

@@ -24,6 +24,8 @@ import jax
 import numpy as np
 
 from .. import telemetry, utils
+from ..telemetry import blackbox, goodput
+from ..telemetry import steptrace as steptrace_mod
 from ..parallel import (
     Partitioner, TrainState, batch_nbytes, make_train_step, shard_batch,
 )
@@ -266,6 +268,16 @@ class TrainingContext:
         self.step = 0
         self.step_limit = step_limit
 
+        # observability plane (telemetry.sidecar.TrainObserver reads
+        # these; all host-side, refreshed at the finite-check cadence)
+        self.steptraces = steptrace_mod.StepTraceSummary()
+        self.steps_completed = 0     # readiness = first step completed
+        self._heartbeat_t = None     # step-loop liveness stamp
+        self.last_norms = None       # (grad_norm, update_norm) floats
+        self._pending_norms = None   # staged device scalars, unfetched
+        self.last_memory = None      # latest memory_snapshot fields
+        self.last_checkpoint = None  # (path, step) of the newest save
+
         # executed micro-batches within the current stage; drives the
         # accumulation boundary in lockstep with optax.MultiSteps (which
         # counts tx.update calls) so an invalid-batch skip costs one
@@ -329,6 +341,13 @@ class TrainingContext:
         """Arm the graceful stop (signal-handler and test entry point)."""
         self._stop = reason
 
+    def heartbeat_age(self):
+        """Seconds since the step loop last went around (sidecar
+        liveness); 0.0 before the first instance starts."""
+        if self._heartbeat_t is None:
+            return 0.0
+        return time.perf_counter() - self._heartbeat_t
+
     def _emergency_stop(self, log):
         """Write the preemption checkpoint and log how to resume."""
         reason = self._stop
@@ -359,6 +378,12 @@ class TrainingContext:
         tele.emit("checkpoint", path=str(path), step=self.step,
                   seconds=round(time.perf_counter() - t0, 4),
                   source="emergency")
+        self.last_checkpoint = (path, self.step)
+        # flight recorder: the ring survived the signal path (the handler
+        # only sets _stop; the loop broke out normally), so the bundle
+        # holds the last N steps exactly as the loop saw them
+        blackbox.get().dump(path_dir, f"preempt-{reason}", tele=tele,
+                            checkpoint=str(path), step=self.step)
         log.warn("emergency checkpoint written; resume with '--resume auto'")
         return path
 
@@ -635,6 +660,7 @@ class TrainingContext:
         self._dispatched = 0
         self._last_sync_dispatched = 0
         self._last_sync_t = time.perf_counter()
+        self._pending_norms = None
 
         self.inspector.on_stage_start(log, self, stage)
         telemetry.get().emit(
@@ -666,10 +692,14 @@ class TrainingContext:
             # emergency checkpoint is the only artifact that matters now
             telemetry.get().emit("stage_end", stage=stage.index,
                                  step=self.step, interrupted=True)
+            goodput.get().emit_event(telemetry.get(), stage=stage.index,
+                                     step=self.step)
             return
 
         self.inspector.on_stage(log, self, stage)
         telemetry.get().emit("stage_end", stage=stage.index, step=self.step)
+        goodput.get().emit_event(telemetry.get(), stage=stage.index,
+                                 step=self.step)
 
     def _train_step_key(self, stage, with_grads):
         """Stable ``compile.ProgramKey`` for this stage's train step.
@@ -762,11 +792,23 @@ class TrainingContext:
             depth = max(1, utils.env.get_int("RMD_PREFETCH_DEPTH"))
             batches = _device_prefetch(samples, put, depth=depth, tele=tele)
 
-        for i, (host, dev, meta) in enumerate(batches):
+        it = enumerate(batches)
+        while True:
+            # per-step trace: one perf_counter clock whose marks bracket
+            # the queue pull, so data_wait lands on the step that paid it
+            strace = steptrace_mod.StepTrace(step=self.step)
+            strace.mark("start")
+            nxt = next(it, None)
+            if nxt is None:
+                break
+            i, (host, dev, meta) = nxt
+            strace.mark("data")
+
             log_ = log.new(f"step {self.step}", sep=", ")
             self.log = log_
 
-            self.run_instance(log_, stage, epoch, i, host, dev, meta)
+            self.run_instance(log_, stage, epoch, i, host, dev, meta,
+                              strace=strace)
 
             if self._stop:
                 break
@@ -781,6 +823,7 @@ class TrainingContext:
         # a live-array census — epoch-boundary cheap)
         if tele.enabled or utils.env.get_bool("RMD_DEBUG_MEM"):
             snap = telemetry.memory_snapshot()
+            self.last_memory = snap
             tele.emit("memory", stage=stage.index, epoch=epoch,
                       step=self.step, **snap)
             if utils.env.get_bool("RMD_DEBUG_MEM"):
@@ -806,8 +849,27 @@ class TrainingContext:
         before validation/checkpointing can observe a poisoned state."""
         prev, self._pending_finite = self._pending_finite, None
         if prev is not None:
+            self._sample_norms()
             self._resolve_finite(log, prev,
                                  "non-finite flow values detected")
+
+    def _sample_norms(self):
+        """Fetch the staged grad/update norm scalars for the gauges.
+
+        Called only at the amortized finite-fetch cadence, where the
+        pipeline is already drained by the finite flag — the two extra
+        scalar fetches ride the same sync, never adding one.
+        """
+        pending, self._pending_norms = self._pending_norms, None
+        if pending is None:
+            return
+        g, u = pending
+        try:
+            self.last_norms = (
+                None if g is None else float(g),  # graftlint: disable=host-sync -- rides the amortized finite fetch, pipeline already drained
+                None if u is None else float(u))  # graftlint: disable=host-sync -- rides the amortized finite fetch, pipeline already drained
+        except Exception:  # noqa: BLE001 - gauges must never kill a step
+            self.last_norms = None
 
     def _resolve_finite(self, log, prev, msg):
         """Apply the non-finite policy to one resolved finite fetch.
@@ -929,9 +991,18 @@ class TrainingContext:
             to_step=chkpt.iteration.step, rollbacks=self._nf_rollbacks,
         )
 
-    def run_instance(self, log, stage, epoch, i, host, dev, meta):
+    def run_instance(self, log, stage, epoch, i, host, dev, meta,
+                     strace=None):
         accumulate = stage.gradient.accumulate
         img1, img2, flow, valid = host
+
+        self._heartbeat_t = time.perf_counter()
+        if strace is None:
+            # direct callers (tests) skip the run_epoch pull bracket:
+            # start the clock here with an empty data_wait phase
+            strace = steptrace_mod.StepTrace(step=self.step)
+            strace.mark("start")
+            strace.mark("data")
 
         # wire mode: host images are un-normalized wire dtype. Observers
         # that consume pixel values (TB image dumps, intermediates
@@ -984,10 +1055,17 @@ class TrainingContext:
         self.inspector.on_batch_start(log, self, stage, epoch, i, img1, img2,
                                       flow, valid, meta)
 
+        # host prep done; the transfer itself was staged by the prefetch
+        # worker (its cost is the worker-attributed device_put phase), so
+        # the consumer-side device_put mark lands immediately
+        strace.mark("prep")
+        strace.mark("put")
+
         tele = telemetry.get()
         with tele.span("dispatch"):
             self.state, aux = self.step_fn(self.state, lr, *dev)
         self._dispatched += 1
+        strace.mark("dispatched")
 
         # validate output, check for non-finite numbers — DEFERRED and
         # AMORTIZED: bool(finite) is a device->host fetch, and fetching
@@ -1000,6 +1078,8 @@ class TrainingContext:
         # detection just fires up to _finite_every-1 steps late, and
         # _flush_finite_check resolves the epoch's last step before
         # validation or checkpointing can observe the state.
+        self._pending_norms = (aux.get("grad_norm"),
+                               aux.get("update_norm"))
         if self.validate:
             self._pending_finite = (aux["finite"], stage, epoch,
                                     aux.get("nonfinite_count"))
@@ -1008,6 +1088,7 @@ class TrainingContext:
                 t0 = time.perf_counter()
                 finite = bool(prev[0])
                 self._emit_device_sync(tele, time.perf_counter() - t0)
+                self._sample_norms()
                 self._resolve_finite(
                     log, (finite,) + prev[1:],
                     "non-finite flow values detected (flagged on a "
@@ -1020,6 +1101,10 @@ class TrainingContext:
             t0 = time.perf_counter()
             jax.block_until_ready(aux["loss"])
             self._emit_device_sync(tele, time.perf_counter() - t0)
+            self._sample_norms()
+        # device phase = how long the fetch above blocked (zero on the
+        # amortized steps in between) — never an extra sync
+        strace.mark("synced")
 
         loss = aux["loss"]
 
@@ -1057,7 +1142,18 @@ class TrainingContext:
                             batch=stage.data.batch_size)
             self.inspector.on_step_end(log, self, stage, epoch, i)
             self.step += 1
+            self.steps_completed += 1
             self._in_step = False
+
+        # close the trace: every phase is a perf_counter diff on one
+        # clock, so the record telescopes exactly to the step total
+        strace.mark("done")
+        rec = self.steptraces.add(strace)
+        blackbox.get().record_step(rec)
+        if tele.enabled and (i + 1) % self._finite_every == 0:
+            ev = self.steptraces.event(self.step)
+            if ev is not None:
+                tele.emit("steptrace", **ev)
 
     def _wants_host_images(self):
         """Whether the inspector will consume pixel values this step.
@@ -1125,4 +1221,8 @@ class TrainingContext:
                      for s, ids in self._recent_samples],
         )
 
-        self._snapshot_checkpoint(stage, epoch).save(self.path / "failed.ckpt")
+        failed = self.path / "failed.ckpt"
+        self._snapshot_checkpoint(stage, epoch).save(failed)
+        self.last_checkpoint = (failed, self.step)
+        blackbox.get().dump(self.path, "nonfinite", tele=telemetry.get(),
+                            checkpoint=str(failed), step=self.step)

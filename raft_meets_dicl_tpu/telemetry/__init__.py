@@ -15,7 +15,17 @@ producer usage::
 null sink and ``activate`` skips the jax.monitoring hookup).
 """
 
-from . import core, metrics, report, slo, trace
+from . import (
+    blackbox,
+    core,
+    goodput,
+    metrics,
+    report,
+    sidecar,
+    slo,
+    steptrace,
+    trace,
+)
 from .core import (
     SCHEMA,
     SCHEMA_MINOR,
@@ -37,7 +47,8 @@ from .core import (
 )
 
 __all__ = [
-    "core", "metrics", "report", "slo", "trace",
+    "blackbox", "core", "goodput", "metrics", "report", "sidecar",
+    "slo", "steptrace", "trace",
     "SCHEMA", "SCHEMA_MINOR", "SCHEMA_VERSION",
     "NewerSchema", "NullTelemetry", "Telemetry", "UnknownKind",
     "activate", "create", "deactivate", "enabled", "get",

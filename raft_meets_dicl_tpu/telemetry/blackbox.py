@@ -1,0 +1,155 @@
+"""Flight recorder: a bounded in-memory ring that turns a dying run
+into a postmortem artifact.
+
+While training runs, the recorder keeps the last N step-trace records
+(``RMD_BLACKBOX_STEPS``) and a short ring of recent telemetry events —
+append-only host work, no sync, no I/O.  When the run dies — crash,
+non-finite escalation, or SIGTERM — :meth:`FlightRecorder.dump` writes
+one JSON bundle next to the emergency checkpoint:
+
+- the step-trace ring (the last N steps as the loop saw them),
+- the recent-event ring,
+- the run config (as recorded by ``cmd/train.py``),
+- a snapshot of every registered ``RMD_*`` knob (value + whether set),
+- the git revision,
+- the last metrics scrape (the ``rmd_*`` registry rendered at dump
+  time), and
+- the reason + the checkpoint the bundle sits next to,
+
+and emits a ``postmortem`` telemetry event pointing at it.  Dumping is
+once-per-process (first reason wins): the nonfinite raise path and the
+crash handler in ``cmd/train.py`` may both fire for one death.
+
+Like the sink and the goodput ledger, a process-wide active recorder
+(:func:`activate` / :func:`get` / the no-op :class:`NullRecorder`)
+keeps the training loop free of conditionals.
+"""
+
+import json
+import time
+from collections import deque
+from pathlib import Path
+
+from ..utils import env, vcs
+
+DEFAULT_STEPS = 64
+EVENT_RING = 128
+
+
+class NullRecorder:
+    """Inactive recorder: every operation is a no-op."""
+
+    enabled = False
+
+    def record_step(self, record):
+        pass
+
+    def observe(self, kind, fields):
+        pass
+
+    def dump(self, directory, reason, **extra):
+        return None
+
+
+def knob_snapshot():
+    """Current value of every registered RMD_* knob (and whether the
+    environment actually sets it)."""
+    out = {}
+    for name in sorted(env.KNOBS):
+        out[name] = {"value": env.get(name), "set": env.is_set(name)}
+    return out
+
+
+class FlightRecorder:
+    """Bounded ring of recent step traces + telemetry events."""
+
+    enabled = True
+
+    def __init__(self, capacity=DEFAULT_STEPS, event_capacity=EVENT_RING,
+                 config=None, registry=None):
+        self.capacity = int(capacity)
+        self._steps = deque(maxlen=self.capacity)
+        self._events = deque(maxlen=int(event_capacity))
+        self.config = config
+        self.registry = registry
+        self.dumped = None  # path of the bundle once written
+
+    # -- recording (hot path: append-only, no sync, no I/O) ------------------
+
+    def record_step(self, record):
+        self._steps.append(record)
+
+    def observe(self, kind, fields):
+        """Event tap called by ``Telemetry.emit``; keeps the low-rate
+        run events (everything but the per-step firehose)."""
+        if kind in ("step", "steptrace", "device_sync"):
+            return
+        self._events.append({"kind": kind, **fields})
+
+    # -- postmortem ----------------------------------------------------------
+
+    def bundle(self, reason, **extra):
+        scrape = None
+        if self.registry is not None:
+            try:
+                scrape = self.registry.render()
+            except Exception:  # noqa: BLE001 - postmortem must not raise
+                scrape = None
+        out = {
+            "reason": reason,
+            "time": time.time(),
+            "git": vcs.get_git_head_hash(),
+            "steps": list(self._steps),
+            "events": list(self._events),
+            "config": self.config,
+            "knobs": knob_snapshot(),
+            "metrics": scrape,
+        }
+        out.update(extra)
+        return out
+
+    def dump(self, directory, reason, tele=None, **extra):
+        """Write the postmortem bundle into ``directory``; returns its
+        path (or the already-written path — first reason wins)."""
+        if self.dumped is not None:
+            return self.dumped
+        directory = Path(directory)
+        try:
+            directory.mkdir(parents=True, exist_ok=True)
+            path = directory / f"postmortem-{reason.replace(':', '-')}.json"
+            with open(path, "w") as f:
+                json.dump(self.bundle(reason, **extra), f, indent=2,
+                          default=str)
+        except Exception:  # noqa: BLE001 - postmortem must not mask the death
+            return None
+        self.dumped = path
+        if tele is not None:
+            tele.emit("postmortem", reason=reason, path=str(path),
+                      steps=len(self._steps), events=len(self._events),
+                      checkpoint=extra.get("checkpoint"))
+        return path
+
+
+_active = NullRecorder()
+
+
+def activate(recorder=None, **kwargs):
+    """Install ``recorder`` (or a fresh one built from ``kwargs``) as
+    the process-wide active recorder; returns it."""
+    global _active
+    _active = recorder if recorder is not None else FlightRecorder(**kwargs)
+    return _active
+
+
+def deactivate():
+    global _active
+    _active = NullRecorder()
+
+
+def get():
+    return _active
+
+
+def observe(kind, fields):
+    """Event tap called by ``Telemetry.emit``."""
+    _active.observe(kind, fields)

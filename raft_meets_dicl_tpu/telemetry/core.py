@@ -31,6 +31,9 @@ import os
 import threading
 import time
 
+from . import blackbox as _blackbox
+from . import goodput as _goodput
+
 SCHEMA_VERSION = 1
 
 # Minor revision within the major schema: bumped when kinds or optional
@@ -38,7 +41,7 @@ SCHEMA_VERSION = 1
 # readers keep working); a reader seeing ``v`` with the same major but a
 # larger fractional minor (e.g. 1.2 from a newer producer) should skip
 # the record, not reject the file — see :class:`NewerSchema`.
-SCHEMA_MINOR = 1
+SCHEMA_MINOR = 2
 
 # kind -> required payload fields (beyond the {v, t, kind} envelope).
 # Extra fields are allowed everywhere: the schema pins the floor a
@@ -113,6 +116,17 @@ SCHEMA = {
     # within window_s, burn_rate = (1-attainment)/(1-objective) — burn
     # > 1 means the class is missing its objective at the current rate
     "slo": {"klass", "target_ms", "attainment", "burn_rate"},
+    # trainer step-trace window (steptrace.StepTraceSummary.event):
+    # per-phase rolling p50/p99 + straggler/data-starved flags, emitted
+    # at the amortized finite-check cadence; also reused by evaluation
+    # as a per-bucket progress heartbeat (scope="eval")
+    "steptrace": {"step", "phases"},
+    # wall-clock goodput breakdown (goodput.GoodputLedger.snapshot):
+    # classes sum to total; emitted at stage boundaries and run end
+    "goodput": {"total", "classes"},
+    # flight-recorder bundle written next to the emergency checkpoint
+    # on crash / nonfinite escalation / SIGTERM (blackbox.dump)
+    "postmortem": {"reason", "path"},
 }
 
 
@@ -265,6 +279,10 @@ class Telemetry:
 
     def emit(self, kind, **fields):
         ev = {"v": SCHEMA_VERSION, "t": time.time(), "kind": kind, **fields}
+        # taps run before the sink lock so a consumer may itself emit
+        # (goodput events at stage boundaries, postmortem on dump)
+        _goodput.observe(kind, fields)
+        _blackbox.observe(kind, fields)
         with self._lock:
             self._counts[kind] = self._counts.get(kind, 0) + 1
             if kind == "compile":
@@ -285,7 +303,8 @@ class Telemetry:
                 return ev
             self._buffer.append(ev)
             if (len(self._buffer) >= _FLUSH_EVERY
-                    or kind not in ("step", "device_sync", "compile", "cache")):
+                    or kind not in ("step", "device_sync", "compile", "cache",
+                                    "steptrace")):
                 self._flush_locked()
         return ev
 

@@ -1,0 +1,245 @@
+"""Wall-clock goodput ledger for a training run.
+
+Classifies every second between :meth:`GoodputLedger.start` and "now"
+into one of :data:`CLASSES`:
+
+========== =============================================================
+class      wall time …
+========== =============================================================
+productive driving training steps (the remainder after every overhead
+           class below is subtracted — by construction the classes sum
+           exactly to the total)
+compile    inside jax compilation (the ``compile`` event listener)
+data_starved blocked on the input pipeline (the per-step ``data_wait``
+           phase)
+checkpoint writing checkpoints (``checkpoint`` events, emergency saves
+           included)
+eval       inside validation sweeps (``eval`` events)
+resume_replay between a ``resume`` restore and the first step completed
+           past the restored step, net of time already charged to
+           another class — the cost of getting back to where the
+           preempted run died
+preempted  between the preemption signal (``preempt`` event) and ledger
+           close, net of the emergency-checkpoint charge — teardown
+           wall clock the preemption burned
+========== =============================================================
+
+The ledger is a pure event consumer: :func:`observe` is tapped from
+``Telemetry.emit`` (before the sink lock, so a ledger can itself emit),
+which means checkpoint/eval/compile/preempt/resume accounting needs no
+extra wiring at the call sites.  The step loop additionally charges
+``data_starved`` through the ``step`` event's drained phases.
+
+A process-wide active ledger mirrors the telemetry sink pattern:
+:func:`activate` installs one, :func:`get` returns it (or the no-op
+:class:`NullLedger`), and the ``RMD_GOODPUT`` switch gates activation.
+"""
+
+import threading
+import time
+
+CLASSES = ("productive", "compile", "data_starved", "checkpoint", "eval",
+           "resume_replay", "preempted")
+
+# overhead classes charged explicitly; productive is the remainder
+_CHARGED = tuple(c for c in CLASSES if c != "productive")
+
+
+class NullLedger:
+    """Inactive ledger: every operation is a no-op."""
+
+    enabled = False
+
+    def start(self, t=None):
+        return self
+
+    def charge(self, klass, seconds):
+        pass
+
+    def observe(self, kind, fields):
+        pass
+
+    def snapshot(self, t=None):
+        return {}
+
+    def emit_event(self, tele, **fields):
+        pass
+
+    def publish(self, registry):
+        pass
+
+    def close(self, t=None):
+        return {}
+
+
+class GoodputLedger:
+    """Accounts a run's wall clock into goodput classes.
+
+    All times are ``time.perf_counter`` seconds.  ``snapshot`` computes
+    ``productive`` as ``total - sum(charged classes)`` (clamped at 0),
+    so the classes always sum to the total wall clock.
+    """
+
+    enabled = True
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._t0 = None
+        self._t_close = None
+        self._charges = {c: 0.0 for c in _CHARGED}
+        self._accounted = 0.0
+        # windows: (armed-at, accounted-at-arm); replay also needs the
+        # step to wait for
+        self._replay = None
+        self._replay_until = None
+        self._preempt = None
+        self.replayed_steps = 0
+
+    def start(self, t=None):
+        self._t0 = time.perf_counter() if t is None else float(t)
+        return self
+
+    # -- charging ------------------------------------------------------------
+
+    def charge(self, klass, seconds):
+        if klass not in self._charges:
+            raise ValueError(f"unknown goodput class {klass!r}")
+        seconds = float(seconds)
+        if seconds <= 0:
+            return
+        with self._lock:
+            self._charges[klass] += seconds
+            self._accounted += seconds
+
+    def _window_unaccounted(self, armed, now):
+        """Wall clock of the window net of charges made inside it —
+        what the window burned beyond already-classified work."""
+        t_arm, accounted_arm = armed
+        return max(0.0, (now - t_arm) - (self._accounted - accounted_arm))
+
+    # -- event tap -----------------------------------------------------------
+
+    def observe(self, kind, fields):
+        """Consume one telemetry event (tapped from ``Telemetry.emit``)."""
+        if self._t0 is None:
+            return
+        if kind == "compile":
+            self.charge("compile", fields.get("seconds") or 0.0)
+        elif kind == "checkpoint":
+            self.charge("checkpoint", fields.get("seconds") or 0.0)
+        elif kind == "eval":
+            self.charge("eval", fields.get("seconds") or 0.0)
+        elif kind == "step":
+            phases = fields.get("phases") or {}
+            self.charge("data_starved", phases.get("data_wait") or 0.0)
+            self.step_completed(fields.get("step"))
+        elif kind == "resume":
+            self.resume_from(fields.get("step"))
+        elif kind == "preempt":
+            with self._lock:
+                if self._preempt is None:
+                    self._preempt = (time.perf_counter(), self._accounted)
+
+    def resume_from(self, step):
+        """Arm the resume-replay window: everything from here until the
+        first step completed past ``step`` (net of other charges) is
+        replay — restore, rebuild, recompile, re-warm."""
+        with self._lock:
+            self._replay = (time.perf_counter(), self._accounted)
+            self._replay_until = int(step or 0)
+
+    def step_completed(self, step):
+        if self._replay is None or step is None:
+            return
+        with self._lock:
+            if self._replay is None or int(step) < self._replay_until:
+                return
+            armed, self._replay = self._replay, None
+            now = time.perf_counter()
+            seconds = self._window_unaccounted(armed, now)
+            self.replayed_steps = max(0, int(step) - self._replay_until)
+            self._charges["resume_replay"] += seconds
+            self._accounted += seconds
+
+    # -- reporting -----------------------------------------------------------
+
+    def snapshot(self, t=None):
+        if self._t0 is None:
+            return {}
+        now = (self._t_close if t is None and self._t_close is not None
+               else (time.perf_counter() if t is None else float(t)))
+        with self._lock:
+            total = max(0.0, now - self._t0)
+            classes = {c: round(v, 4) for c, v in self._charges.items()}
+            accounted = sum(classes.values())
+            classes["productive"] = round(max(0.0, total - accounted), 4)
+            # classes must sum to total: absorb the float residual (and
+            # any over-charge clamp) into the reported total
+            return {
+                "total": round(sum(classes.values()), 4),
+                "wall": round(total, 4),
+                "classes": classes,
+                "goodput": round(classes["productive"]
+                                 / max(sum(classes.values()), 1e-9), 4),
+                "replayed_steps": self.replayed_steps,
+            }
+
+    def emit_event(self, tele, **fields):
+        """Emit the ``goodput`` event with the current breakdown."""
+        snap = self.snapshot()
+        if snap:
+            tele.emit("goodput", **snap, **fields)
+
+    def publish(self, registry):
+        """Refresh the ``rmd_train_goodput_*`` gauges from a snapshot."""
+        snap = self.snapshot()
+        if not snap:
+            return
+        g = registry.gauge(
+            "rmd_train_goodput_seconds",
+            "wall-clock seconds attributed to each goodput class",
+            ("klass",))
+        for klass, seconds in snap["classes"].items():
+            g.labels(klass=klass).set(seconds)
+        registry.gauge(
+            "rmd_train_goodput_ratio",
+            "productive share of total wall clock so far",
+        ).set(snap["goodput"])
+
+    def close(self, t=None):
+        """Freeze the ledger: settle the preemption window and pin the
+        total so later snapshots stop growing."""
+        now = time.perf_counter() if t is None else float(t)
+        with self._lock:
+            if self._preempt is not None:
+                armed, self._preempt = self._preempt, None
+                seconds = self._window_unaccounted(armed, now)
+                self._charges["preempted"] += seconds
+                self._accounted += seconds
+            self._t_close = now
+        return self.snapshot()
+
+
+_active = NullLedger()
+
+
+def activate(ledger=None):
+    """Install ``ledger`` (or a fresh started one) as the process-wide
+    active ledger; returns it."""
+    global _active
+    _active = ledger if ledger is not None else GoodputLedger().start()
+    return _active
+
+
+def deactivate():
+    global _active
+    _active = NullLedger()
+
+
+def get():
+    return _active
+
+
+def observe(kind, fields):
+    """Event tap called by ``Telemetry.emit``."""
+    _active.observe(kind, fields)

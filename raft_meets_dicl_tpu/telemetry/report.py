@@ -238,6 +238,23 @@ def find_anomalies(events, warmup_steps=DEFAULT_WARMUP_STEPS,
             flags.append(
                 f"run preempted by {e['signal']} at step {e['step']} "
                 "(emergency checkpoint written)")
+        elif e["kind"] == "postmortem":
+            flags.append(
+                f"postmortem bundle written ({e.get('reason', '?')}): "
+                f"{e.get('path', '?')}")
+
+    # chronic data starvation: the steptrace summary marking the run as
+    # starved means the input pipeline — not the device — paces training
+    straces = steptrace_stats(events)
+    if straces and straces["last"] is not None:
+        if straces["last"].get("data_starved"):
+            flags.append(
+                "data-starved training: median step spends most of its "
+                "time in data_wait — scale the input pipeline")
+        if straces["starved"] > 1:
+            flags.append(
+                f"{straces['starved']} steptrace window(s) flagged "
+                "data-starved")
 
     return flags
 
@@ -279,10 +296,63 @@ def cost_stats(events):
 def fault_events(events):
     """The run's fault-tolerance trail, in order: non-finite skips and
     rollbacks, preemption stops, auto-resume pickups, checkpoint
-    quarantines, decode-worker respawns, absorbed bad samples."""
+    quarantines, decode-worker respawns, absorbed bad samples, and
+    flight-recorder postmortem dumps."""
     kinds = ("nonfinite", "preempt", "resume", "quarantine", "respawn",
-             "bad_sample")
+             "bad_sample", "postmortem")
     return [e for e in events if e["kind"] in kinds]
+
+
+def goodput_stats(events):
+    """The run's wall-clock goodput breakdown, from the last ``goodput``
+    event (the ledger's snapshots are cumulative, so the newest one —
+    run-end when the run finished cleanly — covers the whole run)."""
+    snaps = [e for e in events if e["kind"] == "goodput"]
+    if not snaps:
+        return None
+    last = snaps[-1]
+    classes = dict(last.get("classes") or {})
+    total = last.get("total") or sum(classes.values())
+    return {
+        "total": total,
+        "classes": classes,
+        "goodput": last.get("goodput",
+                            (classes.get("productive", 0.0)
+                             / total if total else 0.0)),
+        "replayed_steps": last.get("replayed_steps", 0),
+        "snapshots": len(snaps),
+        "final": bool(last.get("final")),
+    }
+
+
+def steptrace_stats(events):
+    """Trainer step-trace windows + eval progress heartbeats from the
+    ``steptrace`` events. The trainer events carry rolling per-phase
+    p50/p99 snapshots — the last one is the freshest view; the eval
+    events (scope="eval") are per-bucket liveness markers."""
+    train = [e for e in events
+             if e["kind"] == "steptrace" and e.get("scope") != "eval"]
+    evals = [e for e in events
+             if e["kind"] == "steptrace" and e.get("scope") == "eval"]
+    if not train and not evals:
+        return None
+    out = {"windows": len(train), "last": train[-1] if train else None,
+           "stragglers": sum(1 for e in train if e.get("straggler")),
+           "starved": sum(1 for e in train if e.get("data_starved")),
+           "eval_buckets": [
+               {"name": e.get("name"), "bucket": e.get("bucket"),
+                "batches": e.get("window"), "samples": e.get("samples"),
+                "seconds": e.get("total"), "phases": e.get("phases", {})}
+               for e in evals]}
+    return out
+
+
+def postmortem_stats(events):
+    """Flight-recorder dumps: one entry per ``postmortem`` event."""
+    return [{"reason": e.get("reason"), "path": e.get("path"),
+             "steps": e.get("steps"), "events": e.get("events"),
+             "checkpoint": e.get("checkpoint")}
+            for e in events if e["kind"] == "postmortem"]
 
 
 def aot_stats(events):
@@ -575,6 +645,50 @@ def render(events, errors=(), warmup_steps=DEFAULT_WARMUP_STEPS,
             f"({dev['samples']} syncs, mean drain "
             f"{dev['mean_drain'] * 1e3:.2f} ms)")
 
+    straces = steptrace_stats(events)
+    if straces and straces["last"]:
+        last = straces["last"]
+        lines.append("")
+        lines.append(f"== step traces ({straces['windows']} windows) ==")
+        lines.append(f"{'phase':<12} {'p50':>9} {'p99':>9}")
+        for phase, pcts in last.get("phases", {}).items():
+            lines.append(f"{phase:<12} {pcts['p50_ms']:9.2f} "
+                         f"{pcts['p99_ms']:9.2f}")
+        total = last.get("total_ms", {})
+        lines.append(f"{'total':<12} {total.get('p50', 0):9.2f} "
+                     f"{total.get('p99', 0):9.2f}")
+        if straces["stragglers"] or straces["starved"]:
+            lines.append(
+                f"flags: {straces['stragglers']} straggler window(s), "
+                f"{straces['starved']} data-starved window(s)")
+    if straces and straces["eval_buckets"]:
+        lines.append("")
+        lines.append(f"== eval progress ({len(straces['eval_buckets'])} "
+                     f"buckets) ==")
+        for b in straces["eval_buckets"]:
+            lines.append(
+                f"{b['name'] or 'eval':<16} {b['bucket'] or '?':<12} "
+                f"{b['batches'] or 0:4d} batches  "
+                f"{b['samples'] or 0:5d} samples  "
+                f"{b['seconds'] or 0:8.2f} s")
+
+    goodput = goodput_stats(events)
+    if goodput:
+        lines.append("")
+        lines.append("== goodput ==")
+        total = goodput["total"]
+        lines.append(
+            f"wall clock: {total:.2f} s, goodput "
+            f"{goodput['goodput'] * 100:.1f}% productive"
+            + (f", {goodput['replayed_steps']} step(s) replayed"
+               if goodput["replayed_steps"] else ""))
+        for klass, secs in sorted(goodput["classes"].items(),
+                                  key=lambda kv: -kv[1]):
+            if secs <= 0 and klass != "productive":
+                continue
+            share = secs / total * 100 if total else 0.0
+            lines.append(f"{klass:<14} {secs:9.2f} s {share:6.1f}%")
+
     shardings = sharding_stats(events)
     if shardings:
         lines.append("")
@@ -768,6 +882,21 @@ def render(events, errors=(), warmup_steps=DEFAULT_WARMUP_STEPS,
                 lines.append(
                     f"  substituted bad sample {e['index']}"
                     + (f" ({e['error']})" if "error" in e else ""))
+            elif kind == "postmortem":
+                lines.append(
+                    f"  postmortem bundle ({e.get('reason', '?')}): "
+                    f"'{e.get('path', '?')}'")
+
+    posts = postmortem_stats(events)
+    if posts:
+        lines.append("")
+        lines.append(f"== postmortem ({len(posts)}) ==")
+        for p in posts:
+            lines.append(
+                f"{p['reason'] or '?':<20} {p['steps'] or 0:4d} step "
+                f"trace(s), {p['events'] or 0:4d} event(s): '{p['path']}'"
+                + (f" (checkpoint '{p['checkpoint']}')"
+                   if p.get("checkpoint") else ""))
 
     lint = lint_stats(events)
     if lint["total"]:
@@ -820,5 +949,143 @@ def render(events, errors=(), warmup_steps=DEFAULT_WARMUP_STEPS,
         lines.extend(f"  ! {f}" for f in flags)
     else:
         lines.append("== anomalies: none ==")
+
+    return "\n".join(lines)
+
+
+# -- multi-run merge ---------------------------------------------------------
+
+# merged-timeline landmarks: the low-rate run-shape events worth
+# interleaving across hosts (the per-step firehose would drown them)
+MERGE_KINDS = ("run_start", "stage_start", "stage_end", "compile",
+               "checkpoint", "resume", "preempt", "postmortem",
+               "nonfinite", "run_end")
+
+# eager-op compiles (model init fires hundreds of ms-scale 'jit' ones)
+# are noise at timeline granularity; only program-scale compiles are
+# landmarks
+MERGE_COMPILE_MIN_S = 0.5
+
+
+def _is_landmark(e):
+    if e["kind"] not in MERGE_KINDS:
+        return False
+    if e["kind"] == "compile":
+        return e.get("seconds", 0.0) >= MERGE_COMPILE_MIN_S
+    return True
+
+
+def merge_stats(runs):
+    """Cross-run statistics for a merged report.
+
+    ``runs`` is a list of ``{"label": str, "events": [...]}`` dicts (one
+    per host / run id, events already schema-validated). All runs share
+    the ``t`` wall clock (``time.time()``), so cross-host deltas are as
+    honest as the hosts' NTP. Returns per-run rows (start skew vs the
+    earliest host, median step time, straggler delta vs the fastest
+    host, goodput) plus the merged landmark timeline.
+    """
+    rows = []
+    t0s, medians = {}, {}
+    for run in runs:
+        label, events = run["label"], run["events"]
+        ts = [e["t"] for e in events]
+        steps = sorted(e["step_time"] for e in events
+                       if e["kind"] == "step")
+        t0s[label] = min(ts) if ts else None
+        medians[label] = steps[len(steps) // 2] if steps else None
+        gp = goodput_stats(events)
+        rows.append({
+            "label": label,
+            "t0": t0s[label],
+            "t_end": max(ts) if ts else None,
+            "events": len(events),
+            "steps": len(steps),
+            "median_step_s": medians[label],
+            "goodput": gp["goodput"] if gp else None,
+        })
+
+    anchor = min((t for t in t0s.values() if t is not None), default=None)
+    fastest = min((m for m in medians.values() if m is not None),
+                  default=None)
+    for row in rows:
+        # skew: how late this host's stream starts vs the earliest one
+        row["skew_s"] = (row["t0"] - anchor
+                         if anchor is not None and row["t0"] is not None
+                         else None)
+        # straggler delta: median step time vs the fastest host's median
+        row["straggler_x"] = (row["median_step_s"] / fastest
+                              if fastest and row["median_step_s"]
+                              else None)
+
+    timeline = []
+    for run in runs:
+        for e in run["events"]:
+            if _is_landmark(e):
+                timeline.append((e["t"], run["label"], e))
+    timeline.sort(key=lambda item: item[0])
+    return {"anchor": anchor, "rows": rows, "timeline": timeline}
+
+
+def _describe_landmark(e):
+    kind = e["kind"]
+    if kind == "compile":
+        return f"compile '{e.get('label', '?')}' {e['seconds']:.2f} s"
+    if kind == "checkpoint":
+        return f"checkpoint @ step {e.get('step', '?')}"
+    if kind == "stage_start":
+        return f"stage {e.get('stage', '?')} start"
+    if kind == "stage_end":
+        return f"stage {e.get('stage', '?')} end"
+    if kind == "resume":
+        return f"resume @ step {e.get('step', '?')}"
+    if kind == "preempt":
+        return f"preempt ({e.get('signal', '?')}) @ step {e.get('step', '?')}"
+    if kind == "postmortem":
+        return f"postmortem ({e.get('reason', '?')})"
+    if kind == "nonfinite":
+        return f"nonfinite @ step {e.get('step', '?')}"
+    return kind
+
+
+def render_merged(runs):
+    """Render multiple runs' event streams as one report: a per-host
+    table (skew / median step / straggler delta / goodput) followed by
+    the merged landmark timeline on the shared wall clock."""
+    merged = merge_stats(runs)
+    width = max([len(r["label"]) for r in merged["rows"]] + [4])
+    lines = [f"== merged report ({len(runs)} run(s)) ==", ""]
+    lines.append(f"{'run':<{width}} {'events':>7} {'steps':>6} "
+                 f"{'skew':>9} {'med step':>9} {'straggler':>9} "
+                 f"{'goodput':>8}")
+    for r in merged["rows"]:
+        skew = (f"{r['skew_s']:+8.2f}s" if r["skew_s"] is not None
+                else f"{'-':>9}")
+        med = (_fmt_ms(r["median_step_s"])
+               if r["median_step_s"] is not None else "-")
+        strag = (f"{r['straggler_x']:8.2f}x"
+                 if r["straggler_x"] is not None else f"{'-':>9}")
+        gp = (f"{r['goodput'] * 100:7.1f}%"
+              if r["goodput"] is not None else f"{'-':>8}")
+        lines.append(f"{r['label']:<{width}} {r['events']:>7} "
+                     f"{r['steps']:>6} {skew:>9} {med:>9} {strag:>9} "
+                     f"{gp:>8}")
+
+    stragglers = [r for r in merged["rows"]
+                  if r["straggler_x"] is not None
+                  and r["straggler_x"] > DEFAULT_SPIKE_FACTOR / 2]
+    for r in stragglers:
+        lines.append(f"  ! straggler: '{r['label']}' steps "
+                     f"{r['straggler_x']:.2f}x slower than the fastest "
+                     f"host")
+
+    if merged["timeline"]:
+        anchor = merged["anchor"] or merged["timeline"][0][0]
+        lines.append("")
+        lines.append(f"== merged timeline ({len(merged['timeline'])} "
+                     f"landmark(s), t0 = earliest host) ==")
+        for t, label, e in merged["timeline"]:
+            lines.append(f"  +{t - anchor:9.2f}s  {label:<{width}}  "
+                         f"{_describe_landmark(e)}")
 
     return "\n".join(lines)

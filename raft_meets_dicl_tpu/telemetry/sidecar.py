@@ -1,0 +1,288 @@
+"""Shared observability sidecar: the HTTP plane behind both commands.
+
+PR 13 gave ``serve`` a stdlib HTTP server (daemon thread, no
+dependency) exposing /metrics, /healthz, /statusz and /profilez; the
+trainer needs the identical surface, so the server lives here and both
+``serve/observe.py`` and ``cmd/train.py`` bind their own observer to
+it.  An *observer* is any object with four methods::
+
+    metrics_text() -> str                  # Prometheus text exposition
+    health()       -> (payload, code)      # JSON body + HTTP status
+    status()       -> payload              # JSON snapshot
+    profile(seconds) -> payload            # jax profiler capture
+
+``ROUTES`` below is the authoritative route table — graftlint's
+``sidecar-route`` rule checks every entry appears in the README
+observability section, so the docs can't silently drift from the
+server.
+
+The server binds ``127.0.0.1`` (an observability sidecar, not a public
+API) and ``port=0`` picks an ephemeral port (tests).
+"""
+
+import json
+import tempfile
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from . import metrics as metrics_mod
+
+# every route the sidecar serves; graftlint:sidecar-route checks these
+# against the README observability table
+ROUTES = ("/metrics", "/healthz", "/statusz", "/profilez")
+
+# liveness: both loops (serve dispatch, train step) go around at least
+# every second in the healthy case; 10x margin tolerates a loaded host
+STALE_HEARTBEAT_S = 10.0
+MAX_PROFILE_S = 60.0
+DEFAULT_PROFILE_S = 3.0
+
+
+class ProfileBusy(RuntimeError):
+    pass
+
+
+def capture_profile(lock, seconds, max_seconds=MAX_PROFILE_S):
+    """Capture ``seconds`` of jax profiler trace into a fresh directory.
+
+    Single-flight on ``lock``: a second request while one runs raises
+    :class:`ProfileBusy` (the handler maps it to a 409), so a scrape
+    loop can't stack captures.
+    """
+    seconds = min(max(float(str(seconds)), 0.1), float(max_seconds))  # graftlint: disable=host-sync -- query-string scalar, not a device value
+    if not lock.acquire(blocking=False):
+        raise ProfileBusy("a profile capture is already running")
+    try:
+        import jax
+
+        out = tempfile.mkdtemp(prefix="rmd-profilez-")
+        jax.profiler.start_trace(out)
+        time.sleep(seconds)
+        jax.profiler.stop_trace()
+        return {"dir": out, "seconds": seconds}
+    finally:
+        lock.release()
+
+
+class Handler(BaseHTTPRequestHandler):
+    observer = None  # bound by SidecarServer via subclass attribute
+
+    def log_message(self, fmt, *args):  # silence per-request stderr spam
+        pass
+
+    def _send(self, code, body, content_type="application/json"):
+        data = body if isinstance(body, bytes) else body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _send_json(self, code, payload):
+        self._send(code, json.dumps(payload, indent=2) + "\n")
+
+    def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler contract
+        url = urlparse(self.path)
+        obs = self.observer
+        try:
+            if url.path == "/metrics":
+                self._send(200, obs.metrics_text(),
+                           "text/plain; version=0.0.4; charset=utf-8")
+            elif url.path == "/healthz":
+                payload, code = obs.health()
+                self._send_json(code, payload)
+            elif url.path == "/statusz":
+                self._send_json(200, obs.status())
+            elif url.path == "/profilez":
+                qs = parse_qs(url.query)
+                seconds = qs.get("seconds", [DEFAULT_PROFILE_S])[0]
+                self._send_json(200, obs.profile(seconds))
+            else:
+                self._send_json(404, {"error": f"no route {url.path}"})
+        except ProfileBusy as e:
+            self._send_json(409, {"error": str(e)})
+        except Exception as e:  # noqa: BLE001 - a scrape must not kill the host process
+            self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
+
+
+class SidecarServer:
+    """The bound HTTP server + its daemon thread."""
+
+    def __init__(self, observer, port, host="127.0.0.1",
+                 thread_name="obs-sidecar"):
+        handler = type("BoundHandler", (Handler,), {"observer": observer})
+        self.observer = observer
+        self.httpd = ThreadingHTTPServer((host, int(port)), handler)  # graftlint: disable=host-sync -- TCP port number, not a device value
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name=thread_name, daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self._thread.join(timeout=5.0)
+
+    @property
+    def url(self):
+        return f"http://{self.httpd.server_address[0]}:{self.port}"
+
+
+class TrainObserver:
+    """Aggregates one trainer's live state for the HTTP plane.
+
+    - liveness: step-loop heartbeat age (the loop stamps a perf_counter
+      each instance) under the stale threshold;
+    - readiness: the first optimizer step has completed;
+    - /statusz: stage/epoch/step, last checkpoint, nonfinite counters,
+      the step-phase summary and the goodput breakdown.
+
+    Everything it reads is host-side state the training loop already
+    maintains at the amortized finite-check cadence — a scrape never
+    syncs the device.
+    """
+
+    def __init__(self, ctx, sink=None, registry=None, ledger=None,
+                 stale_heartbeat_s=STALE_HEARTBEAT_S):
+        self.ctx = ctx
+        self.sink = sink
+        self.ledger = ledger
+        self.registry = registry or metrics_mod.registry()
+        self.stale_heartbeat_s = float(stale_heartbeat_s)  # graftlint: disable=host-sync -- config scalar, not a device value
+        self._profile_lock = threading.Lock()
+        self._m_ready = self.registry.gauge(
+            "rmd_train_ready", "trainer readiness (first step completed)")
+        self._m_heartbeat = self.registry.gauge(
+            "rmd_train_heartbeat_age_seconds",
+            "seconds since the step loop last went around")
+        self._m_step = self.registry.gauge(
+            "rmd_train_step_index", "current global optimizer step")
+        self._m_dropped = self.registry.gauge(
+            "rmd_telemetry_dropped_total",
+            "telemetry events shed by the bounded non-blocking buffer")
+        self._m_phase_p50 = self.registry.gauge(
+            "rmd_train_step_phase_p50_seconds",
+            "rolling per-phase p50 of the step-trace window", ("phase",))
+        self._m_phase_p99 = self.registry.gauge(
+            "rmd_train_step_phase_p99_seconds",
+            "rolling per-phase p99 of the step-trace window", ("phase",))
+        self._m_goodput = self.registry.gauge(
+            "rmd_train_goodput_seconds",
+            "wall-clock seconds attributed to each goodput class",
+            ("klass",))
+        self._m_goodput_ratio = self.registry.gauge(
+            "rmd_train_goodput_ratio",
+            "productive share of total wall clock so far")
+        self._m_hbm = self.registry.gauge(
+            "rmd_train_hbm_peak_gib",
+            "device memory high-water mark (epoch-boundary sample)")
+        self._m_rss = self.registry.gauge(
+            "rmd_train_host_rss_gib",
+            "host resident set size (epoch-boundary sample)")
+        self._m_grad = self.registry.gauge(
+            "rmd_train_grad_norm",
+            "global gradient norm sampled at the finite-fetch cadence")
+        self._m_update = self.registry.gauge(
+            "rmd_train_update_norm",
+            "global update norm sampled at the finite-fetch cadence")
+
+    # -- state ---------------------------------------------------------------
+
+    def ready(self):
+        return bool(getattr(self.ctx, "steps_completed", 0) > 0)
+
+    def heartbeat_age(self):
+        age = getattr(self.ctx, "heartbeat_age", None)
+        return age() if age else 0.0
+
+    def live(self):
+        return self.heartbeat_age() < self.stale_heartbeat_s
+
+    def _refresh_gauges(self):
+        ctx = self.ctx
+        self._m_ready.set(1.0 if self.ready() else 0.0)
+        self._m_heartbeat.set(round(self.heartbeat_age(), 3))
+        self._m_step.set(float(getattr(ctx, "step", 0)))
+        if self.sink is not None:
+            self._m_dropped.set(self.sink.dropped())
+        summary = getattr(ctx, "steptraces", None)
+        if summary is not None:
+            snap = summary.snapshot()
+            for phase, pcts in snap.get("phases", {}).items():
+                self._m_phase_p50.labels(phase=phase).set(pcts["p50_ms"]
+                                                          / 1000.0)
+                self._m_phase_p99.labels(phase=phase).set(pcts["p99_ms"]
+                                                          / 1000.0)
+        if self.ledger is not None:
+            self.ledger.publish(self.registry)
+        mem = getattr(ctx, "last_memory", None)
+        if mem:
+            if mem.get("device_peak_gib") is not None:
+                self._m_hbm.set(mem["device_peak_gib"])
+            if mem.get("host_rss_gib") is not None:
+                self._m_rss.set(mem["host_rss_gib"])
+        norms = getattr(ctx, "last_norms", None)
+        if norms:
+            grad, update = norms
+            if grad is not None:
+                self._m_grad.set(grad)
+            if update is not None:
+                self._m_update.set(update)
+
+    # -- endpoint payloads ---------------------------------------------------
+
+    def metrics_text(self):
+        self._refresh_gauges()
+        return self.registry.render()
+
+    def health(self):
+        ready, age = self.ready(), self.heartbeat_age()
+        live = age < self.stale_heartbeat_s
+        return {
+            "ready": ready,
+            "live": live,
+            "heartbeat_age_s": round(age, 3),
+        }, (200 if ready and live else 503)
+
+    def status(self):
+        ctx = self.ctx
+        summary = getattr(ctx, "steptraces", None)
+        stage = getattr(ctx, "current_stage", None)
+        chkpt = getattr(ctx, "last_checkpoint", None)
+        out = {
+            "ready": self.ready(),
+            "heartbeat_age_s": round(self.heartbeat_age(), 3),
+            "stage": getattr(stage, "index", None),
+            "epoch": getattr(ctx, "current_epoch", None),
+            "step": getattr(ctx, "step", 0),
+            "steps_completed": getattr(ctx, "steps_completed", 0),
+            "last_checkpoint": ({"path": str(chkpt[0]), "step": chkpt[1]}
+                                if chkpt else None),
+            "nonfinite": {
+                "count": getattr(ctx, "_nf_last_count", 0),
+                "consecutive": getattr(ctx, "_nf_consecutive", 0),
+                "rollbacks": getattr(ctx, "_nf_rollbacks", 0),
+            },
+            "steps": summary.snapshot() if summary is not None else {},
+            "goodput": (self.ledger.snapshot()
+                        if self.ledger is not None else {}),
+            "telemetry_dropped": (self.sink.dropped()
+                                  if self.sink is not None else 0),
+        }
+        return out
+
+    def profile(self, seconds):
+        return capture_profile(self._profile_lock, seconds)
+
+
+def train_observer(ctx, port, sink=None, registry=None, ledger=None):
+    """Build and start the trainer sidecar; returns the
+    :class:`SidecarServer` (``.port`` resolves port 0)."""
+    obs = TrainObserver(ctx, sink=sink, registry=registry, ledger=ledger)
+    return SidecarServer(obs, port, thread_name="train-observe").start()

@@ -70,6 +70,17 @@ KNOBS = dict([
     _k("RMD_TELEMETRY_MAX_MB", "float", 0.0,
        "rotate events.jsonl to <path>.1 past this size in MiB (0 = "
        "never rotate)", "telemetry"),
+    _k("RMD_GOODPUT", "switch", True,
+       "account the run's wall clock into goodput classes (productive/"
+       "compile/data-starved/checkpoint/eval/resume-replay/preempted); "
+       "0 disables the ledger", "telemetry"),
+    _k("RMD_BLACKBOX_STEPS", "int", 64,
+       "flight-recorder ring size: last N step traces kept in memory "
+       "for the crash/SIGTERM postmortem bundle", "telemetry"),
+    _k("RMD_TRAIN_METRICS_PORT", "int", 0,
+       "trainer observability HTTP port (/metrics, /healthz, /statusz, "
+       "/profilez); unset = off, 0 = ephemeral; CLI --metrics-port "
+       "wins", "telemetry"),
     # -- input pipeline ----------------------------------------------------
     _k("RMD_WIRE_FORMAT", "str", None,
        "host-to-device wire format preset (f32 | bf16 | u8); CLI "

@@ -15,6 +15,14 @@ AOT artifact to a cold JIT.
     python scripts/telemetry_report.py runs/<ts>/events.jsonl
     python scripts/telemetry_report.py runs/<ts>          # finds the file
     python scripts/telemetry_report.py events.jsonl --strict
+
+Given several paths (one per host / restart), each stream is tagged by
+its run id and rendered as a merged report instead: a per-host table
+(start skew vs the earliest host, median step time, straggler delta vs
+the fastest host, goodput) and a merged landmark timeline on the shared
+wall clock:
+
+    python scripts/telemetry_report.py runs/host0 runs/host1 runs/host2
 """
 
 import argparse
@@ -38,10 +46,27 @@ def resolve(path):
     return p
 
 
+def run_label(path, used):
+    """Tag a stream by its run id: the run directory name (the parent,
+    for an events.jsonl path), deduplicated across identical names."""
+    p = Path(path)
+    base = p.parent.name if p.name == "events.jsonl" else p.stem
+    if p.is_dir():
+        base = p.name
+    label, n = base or str(p), 2
+    while label in used:
+        label = f"{base}#{n}"
+        n += 1
+    used.add(label)
+    return label
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="render a telemetry events.jsonl into a report")
-    ap.add_argument("path", help="events.jsonl file or run directory")
+    ap.add_argument("paths", nargs="+", metavar="path",
+                    help="events.jsonl file or run directory; several "
+                         "paths (one per host) render a merged report")
     ap.add_argument("--warmup-steps", type=int,
                     default=report.DEFAULT_WARMUP_STEPS,
                     help="compiles after this many in-stage steps are "
@@ -54,8 +79,33 @@ def main(argv=None):
                     help="exit non-zero on schema errors or anomalies")
     args = ap.parse_args(argv)
 
+    if len(args.paths) > 1:
+        # multi-run merge: tag each stream by run id, render the
+        # cross-host table + merged timeline
+        runs, all_errors, all_flags = [], [], []
+        used = set()
+        for path in args.paths:
+            label = run_label(path, used)
+            events, errors = report.load_events(resolve(path))
+            runs.append({"label": label, "events": events})
+            all_errors.extend((label, n, msg) for n, msg in errors)
+            all_flags.extend(
+                (label, f) for f in report.find_anomalies(
+                    events, warmup_steps=args.warmup_steps,
+                    spike_factor=args.spike_factor))
+        print(report.render_merged(runs))
+        if all_flags:
+            print(f"\n== anomalies ({len(all_flags)}) ==")
+            for label, flag in all_flags:
+                print(f"  ! [{label}] {flag}")
+        for label, n, msg in all_errors:
+            print(f"  schema error [{label}] line {n}: {msg}")
+        if args.strict and (all_errors or all_flags):
+            return 1
+        return 0
+
     skipped = []
-    events, errors = report.load_events(resolve(args.path),
+    events, errors = report.load_events(resolve(args.paths[0]),
                                         skipped=skipped)
     print(report.render(events, errors, warmup_steps=args.warmup_steps,
                         spike_factor=args.spike_factor))

@@ -133,11 +133,13 @@ def _cli(*args, cwd):
 def test_cli_train_eval_roundtrip(workspace):
     runs = workspace / "runs"
 
-    # train one epoch
+    # train one epoch, trainer sidecar on an ephemeral port (exercises
+    # the --metrics-port boot/teardown glue end to end)
     _cli("train", "-d", str(workspace / "strategy.yaml"),
          "-m", str(workspace / "model.yaml"),
          "-i", str(workspace / "inspect.yaml"),
-         "-o", str(runs), "--limit-steps", "2", cwd=workspace)
+         "-o", str(runs), "--limit-steps", "2", "--metrics-port", "0",
+         cwd=workspace)
 
     run_dir = next(runs.iterdir())
     assert (run_dir / "config.json").exists()

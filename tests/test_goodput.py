@@ -1,0 +1,520 @@
+"""Training goodput plane tests: step traces, ledger, sidecar, blackbox.
+
+The trainer's observability contract, pinned end to end on CPU: the
+per-step trace phases telescope *exactly* to the step total with zero
+added host syncs (steptrace events ride the amortized finite-check
+cadence), the goodput ledger classifies every wall-clock second into
+exactly one class (classes sum to total by construction, resume-replay
+attributed across a SIGTERM → auto-resume drill), the trainer sidecar
+serves /metrics //healthz //statusz over a real socket, and the flight
+recorder dumps a postmortem bundle under both fault drills.
+"""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from raft_meets_dicl_tpu import telemetry
+from raft_meets_dicl_tpu.analysis import lint as lint_mod
+from raft_meets_dicl_tpu.analysis import telemetrykinds
+from raft_meets_dicl_tpu.analysis.lint import Module, ProjectContext
+from raft_meets_dicl_tpu.strategy.checkpoint import find_auto_resume
+from raft_meets_dicl_tpu.telemetry import (
+    blackbox, core, goodput, metrics as metrics_mod, report as treport,
+    sidecar, steptrace,
+)
+from raft_meets_dicl_tpu.testing import faults
+from test_faults import _make_context
+from test_trace import _get
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _goodput_hygiene(monkeypatch):
+    """Fresh sink/registry/ledger/recorder per test; finite check every
+    step so traces and syncs are deterministic."""
+    monkeypatch.delenv("RMD_FAULT", raising=False)
+    monkeypatch.delenv("RMD_FAULT_STATE", raising=False)
+    monkeypatch.setenv("RMD_FINITE_CHECK_EVERY", "1")
+    faults.reset()
+    metrics_mod.reset()
+    sink = telemetry.activate(telemetry.Telemetry())
+    yield sink
+    telemetry.deactivate()
+    goodput.deactivate()
+    blackbox.deactivate()
+    metrics_mod.reset()
+    faults.reset()
+
+
+def _events(sink, kind, **match):
+    return [e for e in sink.events if e["kind"] == kind
+            and all(e.get(k) == v for k, v in match.items())]
+
+
+# -- step-trace decomposition -------------------------------------------------
+
+
+def test_steptrace_phases_telescope_exactly():
+    st = steptrace.StepTrace(step=7)
+    for i, mark in enumerate(steptrace.MARKS):
+        st.mark(mark, t=100.0 + i * 0.125)
+    phases = st.phases()
+    assert set(phases) == set(steptrace.PHASES)
+    # exact telescoping: differences of one clock at consecutive marks
+    # sum to the total with no residual
+    assert sum(phases.values()) == st.total() == pytest.approx(0.75)
+    rec = st.record()
+    assert rec["step"] == 7
+    assert sum(rec["phases"].values()) == pytest.approx(rec["total"],
+                                                        abs=1e-5)
+
+
+def test_steptrace_skipped_marks_still_cover_the_step():
+    # a step without a finite-check fetch never hits "synced"; the span
+    # is attributed to the phase named by its left mark, so coverage
+    # stays exact
+    st = steptrace.StepTrace(step=0)
+    st.mark("start", t=1.0).mark("data", t=1.5).mark("prep", t=1.6)
+    st.mark("dispatched", t=1.9).mark("done", t=2.25)
+    phases = st.phases()
+    assert sum(phases.values()) == st.total() == pytest.approx(1.25)
+    assert phases["device"] == pytest.approx(0.35)  # dispatched→done
+    assert phases["device_put"] == pytest.approx(0.3)  # prep→dispatched
+
+
+def test_steptrace_unknown_mark_rejected():
+    with pytest.raises(ValueError, match="unknown step mark"):
+        steptrace.StepTrace().mark("teleport")
+
+
+def _rec(step, total, data_wait=0.0):
+    return {"step": step, "total": total,
+            "phases": {"data_wait": data_wait,
+                       "device": total - data_wait}}
+
+
+def test_steptrace_summary_bounded_and_flags():
+    s = steptrace.StepTraceSummary(capacity=8)
+    for i in range(32):
+        s.add(_rec(i, 0.1))
+    assert len(s) == 8  # bounded: old records fall off
+    snap = s.snapshot()
+    assert snap["count"] == 8 and not snap["straggler"]
+    assert snap["total_ms"]["p50"] == pytest.approx(100.0)
+
+    s.add(_rec(32, 0.5))  # 5x the median: the last step is a straggler
+    assert s.snapshot()["straggler"]
+
+    starved = steptrace.StepTraceSummary(capacity=8)
+    for i in range(8):
+        starved.add(_rec(i, 0.1, data_wait=0.08))
+    assert starved.snapshot()["data_starved"]
+
+
+def test_steptrace_summary_event_windows():
+    s = steptrace.StepTraceSummary()
+    assert s.event(step=0) is None  # empty window emits nothing
+    s.add(_rec(0, 0.1))
+    s.add(_rec(1, 0.2))
+    ev = s.event(step=2)
+    assert ev["window"] == 2 and ev["step"] == 2
+    assert s.event(step=2) is None  # drained
+
+
+# -- goodput ledger -----------------------------------------------------------
+
+
+def test_goodput_classes_sum_to_total():
+    led = goodput.GoodputLedger().start(t=0.0)
+    led.charge("compile", 2.0)
+    led.charge("checkpoint", 0.5)
+    led.charge("eval", 1.0)
+    snap = led.snapshot(t=10.0)
+    assert snap["classes"]["compile"] == 2.0
+    assert snap["classes"]["productive"] == pytest.approx(6.5)
+    assert sum(snap["classes"].values()) == pytest.approx(snap["total"],
+                                                          abs=1e-9)
+    assert snap["goodput"] == pytest.approx(0.65)
+
+
+def test_goodput_overcharge_clamps_productive():
+    led = goodput.GoodputLedger().start(t=0.0)
+    led.charge("compile", 20.0)  # charged more than elapsed: clamp at 0
+    snap = led.snapshot(t=10.0)
+    assert snap["classes"]["productive"] == 0.0
+    assert sum(snap["classes"].values()) == pytest.approx(snap["total"],
+                                                          abs=1e-9)
+
+
+def test_goodput_unknown_class_rejected():
+    with pytest.raises(ValueError, match="unknown goodput class"):
+        goodput.GoodputLedger().start().charge("coffee", 1.0)
+
+
+def test_goodput_tap_classifies_telemetry_events(_goodput_hygiene):
+    led = goodput.activate()
+    tele = telemetry.get()
+    tele.emit("compile", label="step", seconds=0.25)
+    tele.emit("checkpoint", path="x.ckpt", step=1, seconds=0.125)
+    tele.emit("eval", name="val", samples=4, batches=2, seconds=0.5)
+    tele.emit("step", step=1, phases={"data_wait": 0.0625}, step_time=0.1,
+              throughput_ema=1.0)
+    snap = led.snapshot()
+    assert snap["classes"]["compile"] == pytest.approx(0.25)
+    assert snap["classes"]["checkpoint"] == pytest.approx(0.125)
+    assert snap["classes"]["eval"] == pytest.approx(0.5)
+    assert snap["classes"]["data_starved"] == pytest.approx(0.0625)
+
+
+def test_goodput_resume_replay_window_settles():
+    led = goodput.GoodputLedger().start()
+    led.resume_from(5)
+    led.step_completed(4)  # still behind the restored step: window open
+    assert led._replay is not None
+    led.step_completed(7)
+    assert led._replay is None
+    assert led.replayed_steps == 2
+    snap = led.snapshot()
+    assert snap["classes"]["resume_replay"] >= 0.0
+    assert snap["replayed_steps"] == 2
+
+
+def test_goodput_close_pins_total_and_settles_preempt():
+    import time
+
+    led = goodput.GoodputLedger().start()
+    led.observe("preempt", {"signal": "SIGTERM", "step": 3})
+    time.sleep(0.01)  # teardown wall clock the preemption burns
+    snap = led.close()
+    assert snap["classes"]["preempted"] > 0.0
+    time.sleep(0.01)
+    later = led.snapshot()  # closed: the total stops growing
+    assert later["total"] == snap["total"]
+
+
+def test_null_ledger_and_recorder_are_inert(tmp_path):
+    led = goodput.get()
+    assert not led.enabled and led.snapshot() == {}
+    rec = blackbox.get()
+    assert not rec.enabled
+    assert rec.dump(tmp_path, "whatever") is None
+    assert not list(Path(tmp_path).glob("postmortem-*"))
+
+
+# -- schema -------------------------------------------------------------------
+
+
+def test_schema_validates_new_kinds():
+    def base(kind, **fields):
+        return {"v": core.SCHEMA_VERSION, "t": 0.0, "kind": kind, **fields}
+
+    core.validate_event(base("steptrace", step=3, phases={}))
+    core.validate_event(base("goodput", total=1.0, classes={}))
+    core.validate_event(base("postmortem", reason="crash", path="x.json"))
+    with pytest.raises(ValueError):
+        core.validate_event(base("steptrace", step=3))  # missing phases
+    with pytest.raises(ValueError):
+        core.validate_event(base("goodput", total=1.0))
+    with pytest.raises(ValueError):
+        core.validate_event(base("postmortem", reason="crash"))
+
+
+# -- training loop integration ------------------------------------------------
+
+
+def test_training_emits_steptraces_at_sync_cadence(tmp_path,
+                                                   _goodput_hygiene):
+    led = goodput.activate()
+    ctx, _ = _make_context(tmp_path)
+    ctx.run()
+    assert ctx.steps_completed == 2
+
+    straces = _events(_goodput_hygiene, "steptrace")
+    syncs = _events(_goodput_hygiene, "device_sync")
+    assert straces, "the loop must emit steptrace events"
+    # zero added host syncs: steptrace windows ride the existing
+    # finite-check cadence, so there is one event per device_sync sample
+    assert len(straces) == len(syncs)
+    assert sum(e["window"] for e in straces) == ctx.steps_completed
+    # every record's phases telescope to its total (float precision
+    # before rounding is pinned above; records carry 6-decimal rounding)
+    for rec in ctx.steptraces._records:
+        assert sum(rec["phases"].values()) == pytest.approx(rec["total"],
+                                                            abs=1e-5)
+    # in-step norms rode the finite fetch: no extra sync, values present
+    assert ctx.last_norms is not None
+    grad, update = ctx.last_norms
+    assert grad is not None and grad >= 0.0
+    assert update is not None and update >= 0.0
+
+    snap = led.snapshot()
+    assert sum(snap["classes"].values()) == pytest.approx(snap["total"],
+                                                          abs=1e-6)
+
+
+def test_trainer_sidecar_endpoints_over_real_socket(tmp_path,
+                                                    _goodput_hygiene):
+    led = goodput.activate()
+    ctx, _ = _make_context(tmp_path)
+    server = sidecar.train_observer(ctx, 0, sink=_goodput_hygiene,
+                                    ledger=led)
+    try:
+        # before the first step: alive but not ready -> 503
+        code, payload = _get(server.url + "/healthz")
+        assert code == 503 and payload["ready"] is False
+
+        ctx.run()
+
+        code, payload = _get(server.url + "/healthz")
+        assert code == 200
+        assert payload["ready"] is True and payload["live"] is True
+
+        code, text = _get(server.url + "/metrics")
+        assert code == 200
+        assert "rmd_train_ready 1" in text
+        assert "rmd_train_goodput_seconds" in text
+        assert "rmd_train_step_phase_p50_seconds" in text
+        assert "rmd_train_grad_norm" in text
+
+        code, status = _get(server.url + "/statusz")
+        assert code == 200
+        assert status["steps_completed"] == ctx.steps_completed
+        assert status["steps"]["count"] == ctx.steps_completed
+        assert set(status["goodput"]["classes"]) == set(goodput.CLASSES)
+        assert status["nonfinite"]["count"] == 0
+
+        code, _ = _get(server.url + "/bogus")
+        assert code == 404
+    finally:
+        server.close()
+
+
+# -- postmortem drills --------------------------------------------------------
+
+
+def test_postmortem_bundle_on_nonfinite_escalation(tmp_path, monkeypatch,
+                                                   _goodput_hygiene):
+    monkeypatch.setenv(
+        "RMD_FAULT", ",".join(f"nan_update@step={i}" for i in range(8)))
+    faults.reset()
+    blackbox.activate(capacity=8, registry=metrics_mod.registry())
+    ctx, _ = _make_context(
+        tmp_path, nonfinite={"policy": "skip", "max-consecutive": 2},
+        epochs=3)
+    with pytest.raises(RuntimeError, match="persist"):
+        ctx.run()
+
+    path = Path(tmp_path) / "postmortem-nonfinite.json"
+    assert blackbox.get().dumped == path and path.exists()
+    bundle = json.loads(path.read_text())
+    assert bundle["reason"] == "nonfinite"
+    assert bundle["steps"], "the step-trace ring must be in the bundle"
+    assert bundle["knobs"]["RMD_FINITE_CHECK_EVERY"]["set"] is True
+    # the bundle references the failure dump written next to it
+    assert Path(bundle["checkpoint"]).name == "failed.ckpt"
+    assert Path(bundle["checkpoint"]).exists()
+    posts = _events(_goodput_hygiene, "postmortem")
+    assert posts and posts[0]["path"] == str(path)
+
+
+def test_postmortem_bundle_on_sigterm_references_emergency_ckpt(
+        tmp_path, monkeypatch, _goodput_hygiene):
+    monkeypatch.setenv("RMD_FAULT", "sigterm@step=1")
+    faults.reset()
+    blackbox.activate(capacity=8)
+    led = goodput.activate()
+    ctx, _ = _make_context(tmp_path, epochs=2)
+    assert ctx.install_signal_handlers()
+    ctx.run()
+    assert ctx._stop == "SIGTERM"
+    saved_step = ctx.step
+
+    dumped = blackbox.get().dumped
+    assert dumped is not None and dumped.exists()
+    bundle = json.loads(dumped.read_text())
+    assert bundle["reason"].startswith("preempt")
+    # the ring survived the signal path and the bundle sits next to the
+    # emergency checkpoint it references
+    assert bundle["steps"]
+    ckpt = Path(bundle["checkpoint"])
+    assert ckpt.exists() and "emergency" in ckpt.name
+    assert ckpt.parent == dumped.parent
+    assert any(e["kind"] == "preempt" for e in bundle["events"])
+    snap1 = led.close()
+
+    # --resume auto drill: the replay window between the resume event and
+    # the first step past the restored one lands in resume_replay
+    found = find_auto_resume(tmp_path, model="tiny")
+    assert found is not None
+    file, chkpt = found
+    blackbox.deactivate()
+    led2 = goodput.activate()
+    telemetry.get().emit("resume", path=str(file), step=saved_step)
+    ctx2, _ = _make_context(tmp_path, epochs=2)
+    ctx2.run(checkpoint=chkpt)
+    assert ctx2.step > saved_step
+    snap2 = led2.close()
+    assert snap2["classes"]["resume_replay"] > 0.0
+    # the emergency save restored the exact step it stopped at, so the
+    # drill replays no optimizer steps — the replay cost is the window
+    # seconds above (restore, rebuild, re-warm), not repeated work
+    assert snap2["replayed_steps"] == 0
+    for snap in (snap1, snap2):
+        assert sum(snap["classes"].values()) == pytest.approx(
+            snap["total"], abs=1e-6)
+
+
+# -- lint: sidecar-route ------------------------------------------------------
+
+SIDECAR_SRC = Path(sidecar.__file__)
+
+
+def _sidecar_ctx(tmp_path, readme):
+    (tmp_path / "README.md").write_text(readme)
+    mod = Module(SIDECAR_SRC, telemetrykinds.SIDECAR_MODULE,
+                 SIDECAR_SRC.read_text())
+    return ProjectContext(tmp_path, [mod])
+
+
+def test_lint_sidecar_route_rule(tmp_path):
+    documented = " ".join(sidecar.ROUTES)
+    assert not telemetrykinds.check_sidecar_routes(
+        _sidecar_ctx(tmp_path, f"# obs\n{documented}\n"))
+
+    findings = telemetrykinds.check_sidecar_routes(
+        _sidecar_ctx(tmp_path, "# obs\n/metrics /healthz /statusz\n"))
+    assert len(findings) == 1
+    assert "/profilez" in findings[0].message
+
+
+def test_lint_sidecar_route_requires_routes_tuple(tmp_path):
+    (tmp_path / "README.md").write_text("/metrics")
+    mod = Module(SIDECAR_SRC, telemetrykinds.SIDECAR_MODULE,
+                 "x = 1\n")
+    findings = telemetrykinds.check_sidecar_routes(
+        ProjectContext(tmp_path, [mod]))
+    assert findings and "ROUTES" in findings[0].message
+
+
+def test_lint_sidecar_rule_registered_in_default_set():
+    names = {r.name for r in lint_mod.default_rules()}
+    assert telemetrykinds.SIDECAR_RULE in names
+
+
+def test_repo_readme_documents_every_sidecar_route():
+    root = Path(__file__).resolve().parent.parent
+    mod = Module(SIDECAR_SRC, telemetrykinds.SIDECAR_MODULE,
+                 SIDECAR_SRC.read_text())
+    assert not telemetrykinds.check_sidecar_routes(
+        ProjectContext(root, [mod]))
+
+
+# -- report -------------------------------------------------------------------
+
+
+def _ev(kind, t=0.0, **fields):
+    return core.validate_event(
+        {"v": core.SCHEMA_VERSION, "t": t, "kind": kind, **fields})
+
+
+def test_report_renders_goodput_plane_sections():
+    events = [
+        _ev("steptrace", t=1.0, step=4, window=4,
+            phases={"data_wait": {"p50_ms": 1.0, "p99_ms": 2.0},
+                    "device": {"p50_ms": 90.0, "p99_ms": 120.0}},
+            total_ms={"p50": 100.0, "p99": 130.0},
+            straggler=False, data_starved=False),
+        _ev("steptrace", t=2.0, step=2, scope="eval", name="val",
+            bucket="32x48", window=2, samples=4,
+            phases={"dispatch": 0.2}, total=0.25),
+        _ev("goodput", t=3.0, total=10.0, wall=10.0, goodput=0.8,
+            replayed_steps=1,
+            classes={"productive": 8.0, "compile": 1.5,
+                     "checkpoint": 0.5}),
+        _ev("postmortem", t=4.0, reason="nonfinite",
+            path="out/postmortem-nonfinite.json", steps=8, events=12,
+            checkpoint="out/failed.ckpt"),
+    ]
+    text = treport.render(events)
+    assert "== step traces" in text and "data_wait" in text
+    assert "== eval progress" in text and "32x48" in text
+    assert "== goodput ==" in text and "80.0" in text
+    assert "== postmortem" in text and "failed.ckpt" in text
+
+    flags = treport.find_anomalies(events)
+    assert any("postmortem" in f for f in flags)
+
+
+def test_report_flags_data_starved_windows():
+    events = [_ev("steptrace", t=1.0, step=4, window=4, phases={},
+                  total_ms={}, straggler=False, data_starved=True)]
+    flags = treport.find_anomalies(events)
+    assert any("data-starved" in f for f in flags)
+
+
+def test_report_merged_runs_skew_and_stragglers():
+    def step(t, i, wall):
+        return _ev("step", t=t, step=i, phases={}, step_time=wall,
+                   throughput_ema=1.0)
+
+    fast = {"label": "host0", "events": [
+        _ev("run_start", t=100.0, dir="runs/a"),
+        *[step(100.0 + i, i, 0.1) for i in range(5)],
+    ]}
+    slow = {"label": "host1", "events": [
+        _ev("run_start", t=105.0, dir="runs/b"),
+        *[step(105.0 + i, i, 0.4) for i in range(5)],
+        _ev("preempt", t=112.0, signal="SIGTERM", step=4),
+    ]}
+    merged = treport.merge_stats([fast, slow])
+    rows = {r["label"]: r for r in merged["rows"]}
+    assert rows["host0"]["skew_s"] == pytest.approx(0.0)
+    assert rows["host1"]["skew_s"] == pytest.approx(5.0)
+    assert rows["host1"]["straggler_x"] == pytest.approx(4.0)
+    # landmarks from both hosts interleave on the shared clock
+    kinds = [e["kind"] for _, _, e in merged["timeline"]]
+    assert kinds == ["run_start", "run_start", "preempt"]
+
+    text = treport.render_merged([fast, slow])
+    assert "host0" in text and "host1" in text
+    assert "straggler" in text and "merged timeline" in text
+
+
+# -- eval progress heartbeat --------------------------------------------------
+
+
+def test_eval_emits_per_bucket_progress(_goodput_hygiene):
+    import jax
+    import numpy as np
+
+    from raft_meets_dicl_tpu import evaluation
+    from raft_meets_dicl_tpu.models import input as minput
+    from raft_meets_dicl_tpu.models.input import ShapeBuckets
+    from test_eval_buckets import _local_model, _mixed_source
+
+    model = _local_model()
+    source = _mixed_source([(30, 44), (17, 25)], per_shape=2)
+    spec = minput.InputSpec(padding=minput.ModuloPadding("zeros", [8, 8]))
+    variables = model.init(jax.random.PRNGKey(0),
+                           np.zeros((1, 32, 48, 3), np.float32),
+                           np.zeros((1, 32, 48, 3), np.float32))
+    buckets = ShapeBuckets([(32, 48), (24, 32)])
+    loader = spec.apply(source, buckets=buckets).jax().loader(
+        batch_size=2, shuffle=False, num_workers=0, group_by_shape=True)
+
+    stats = evaluation.EvalRunStats(name="val")
+    list(evaluation.evaluate(model, variables, loader, stats=stats,
+                             show_progress=False))
+
+    progress = _events(_goodput_hygiene, "steptrace", scope="eval")
+    # the fix under test: a heartbeat lands per finished bucket, not one
+    # silent gap from warmup to completion
+    assert len(progress) == len(buckets.sizes)
+    assert sum(e["window"] for e in progress) == stats.batches
+    assert sum(e["samples"] for e in progress) == stats.samples
+    assert {e["bucket"] for e in progress} == {"32x48", "24x32"}
+    for e in progress:
+        core.validate_event(dict(e))
