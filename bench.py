@@ -41,7 +41,7 @@ def _emit(result):
             "goodput": snap["goodput"],
             "classes_s": snap["classes"],
         }
-    _emit(result)
+    print(json.dumps(result), flush=True)
     return result
 
 
@@ -720,6 +720,184 @@ def _bench_ladder():
         _emit(result)
 
 
+def _bench_video():
+    """Streaming-video warm-start benchmark (``BENCH_VIDEO=1``): frames/s
+    and EPE at fixed quality, cold vs warm, on synthetic constant-motion
+    sequences.
+
+    Each sequence drifts a random texture by a fixed (dy, dx) per frame
+    (np.roll, exact ground truth). The cold arm runs every frame through
+    the monolithic full-budget rung — the fixed-quality baseline the
+    warm arm must match. The warm arm carries the previous frame's flow
+    through the registered warm-start program at the bottom rung and
+    escalates by the ladder's delta policy; the acceptance claim is that
+    it reaches the cold arm's EPE with fewer mean iterations per frame
+    (a frames/s uplift). The escalation threshold is calibrated like
+    BENCH_LADDER's (upper ``BENCH_VIDEO_PCTL`` quantile of warm-entry
+    deltas — random-init deltas never shrink, see _bench_ladder).
+
+    A fw/bw occlusion-product measurement rides along: the doubled-batch
+    dispatch's cost per frame plus the resulting occlusion ratio (~0 on
+    constant motion away from frame edges). ``BENCH_VIDEO_DATA`` names a
+    Sintel-layout frame directory to run instead of one synthetic
+    sequence (no ground truth there, EPE omitted). One cumulative JSON
+    line per stage; consumers read the last."""
+    from raft_meets_dicl_tpu import models
+    from raft_meets_dicl_tpu.serve.ladder import LadderSpec
+    from raft_meets_dicl_tpu.video import (SequenceRunner, fw_bw_flows,
+                                           fw_bw_products_batch)
+
+    cpu = jax.default_backend() == "cpu"
+    rungs = tuple(int(r) for r in
+                  os.environ.get("BENCH_VIDEO_RUNGS", "4,8,12").split(","))
+    pctl = float(os.environ.get("BENCH_VIDEO_PCTL", "90"))
+    n_frames = int(os.environ.get("BENCH_VIDEO_FRAMES", "8"))
+    budget_s = float(os.environ.get("BENCH_VIDEO_BUDGET_S", "900"))
+    t_start = time.monotonic()
+    if cpu:
+        h, w, batch = 64, 96, 1
+        model_cfg = {"type": "raft/baseline", "parameters": {
+            "corr-levels": 2, "corr-radius": 2, "corr-channels": 32,
+            "context-channels": 16, "recurrent-channels": 16}}
+    else:
+        h, w, batch = 384, 704, 1
+        model_cfg = {"type": "raft/baseline",
+                     "parameters": {"mixed-precision": True}}
+
+    spec = models.load({
+        "name": "bench-video", "id": "bench-video",
+        "model": model_cfg, "loss": {"type": "raft/sequence"},
+        "input": {"padding": {"type": "modulo", "mode": "zeros",
+                              "size": [8, 8]}}})
+    model = spec.model
+
+    # synthetic constant-motion sequences: exact per-pair ground truth
+    motions = [(2, 3), (1, -2), (-2, 1)]
+    rng = np.random.RandomState(7)
+    sequences = []
+    for dy, dx in motions:
+        base = rng.rand(batch, h, w, 3).astype(np.float32)
+        frames = [np.roll(base, (t * dy, t * dx), axis=(1, 2))
+                  for t in range(n_frames)]
+        gt = np.zeros((batch, h, w, 2), np.float32)
+        gt[..., 0] = dx
+        gt[..., 1] = dy
+        sequences.append((frames, [gt] * (n_frames - 1)))
+
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.asarray(sequences[0][0][0]),
+                           jnp.asarray(sequences[0][0][1]), iterations=1)
+
+    # calibration pass: warm frames with escalation disabled, collect the
+    # warm-entry delta signal the threshold quantile pins
+    cal = SequenceRunner(
+        model, variables, model_id=spec.id,
+        ladder=LadderSpec(rungs=rungs, threshold=float("inf")))
+    cal_run = cal.run(sequences[0][0], keep_flows=False)
+    deltas = [float(np.max(np.asarray(f.carry["delta"])))
+              for f in cal_run.frames if f.warm]
+    threshold = float(np.percentile(deltas, pctl))
+
+    runner = SequenceRunner(
+        model, variables, model_id=spec.id,
+        ladder=LadderSpec(rungs=rungs, threshold=threshold))
+
+    # untimed warm-up: a tight-threshold pass escalates through every
+    # continuation rung, so all programs either arm can touch are
+    # compiled before the measured passes (same registry, shared
+    # programs) — frames/s then measures serving, not compilation
+    warmup = SequenceRunner(
+        model, variables, model_id=spec.id,
+        ladder=LadderSpec(rungs=rungs, threshold=1e-12))
+    warmup.run(sequences[0][0][:3], keep_flows=False)
+
+    result = {"metric": "video-warmstart", "rungs": list(rungs),
+              "shape": f"{batch}x{h}x{w}", "frames": n_frames,
+              "sequences": len(sequences),
+              "threshold": round(threshold, 4), "arms": {}}
+
+    def run_arm(warm):
+        epes, its, fps, warm_frames = [], [], [], 0
+        for frames, targets in sequences:
+            run = runner.run(frames, targets=targets, warm=warm,
+                             keep_flows=False)
+            epes.append(run.mean_epe())
+            its.append(run.mean_iterations())
+            fps.append(run.frames_per_sec())
+            warm_frames += run.warm_frames()
+        return {
+            "epe": round(sum(epes) / len(epes), 4),
+            "mean_iterations": round(sum(its) / len(its), 2),
+            "frames_per_sec": round(sum(fps) / len(fps), 3),
+            "warm_frames": warm_frames,
+        }
+
+    result["arms"]["cold"] = run_arm(False)
+    _emit(result)
+    result["arms"]["warm"] = run_arm(True)
+    cold, warmed = result["arms"]["cold"], result["arms"]["warm"]
+    result["uplift"] = {
+        "frames_per_sec_ratio": round(
+            warmed["frames_per_sec"] / max(cold["frames_per_sec"], 1e-9),
+            4),
+        "iterations_ratio": round(
+            warmed["mean_iterations"] / max(cold["mean_iterations"], 1e-9),
+            4),
+        "epe_regression": round(
+            (warmed["epe"] - cold["epe"]) / max(cold["epe"], 1e-9), 4),
+    }
+    _emit(result)
+
+    # fw/bw products: one doubled-batch dispatch on the full rung + the
+    # host-side occlusion/confidence products
+    if time.monotonic() - t_start < budget_s * 0.9:
+        full = runner._full
+        i1 = jnp.asarray(sequences[0][0][0])
+        i2 = jnp.asarray(sequences[0][0][1])
+        fw, bw = fw_bw_flows(full, variables, i1, i2)  # warm the shape
+        jax.block_until_ready(fw)
+        t0 = time.perf_counter()
+        fw, bw = fw_bw_flows(full, variables, i1, i2)
+        jax.block_until_ready(fw)
+        dispatch_ms = 1e3 * (time.perf_counter() - t0)
+        occ, conf = fw_bw_products_batch(np.asarray(fw), np.asarray(bw))
+        result["fwbw"] = {
+            "doubled_batch_ms": round(dispatch_ms, 3),
+            "occlusion_ratio": round(float(occ.mean()), 5),
+            "confidence_mean": round(float(conf.mean()), 5),
+        }
+        _emit(result)
+
+    # optional Sintel-layout sequence (a directory of ordered frames);
+    # no ground truth — the warm arm's iteration/fps accounting only
+    data_dir = os.environ.get("BENCH_VIDEO_DATA")
+    if data_dir:
+        import glob
+
+        import cv2
+
+        paths = sorted(
+            glob.glob(os.path.join(data_dir, "*.png"))
+            + glob.glob(os.path.join(data_dir, "*.jpg")))[:n_frames]
+        if len(paths) >= 2:
+            imgs = []
+            for p in paths:
+                img = cv2.imread(p)[:, :, ::-1].astype(np.float32) / 255.0
+                hh = img.shape[0] - img.shape[0] % 8
+                ww = img.shape[1] - img.shape[1] % 8
+                imgs.append(img[None, :hh, :ww])
+            run = runner.run(imgs, keep_flows=False)
+            result["sintel"] = {
+                "frames": len(run.frames),
+                "mean_iterations": round(run.mean_iterations(), 2),
+                "frames_per_sec": round(run.frames_per_sec(), 3),
+                "warm_frames": run.warm_frames(),
+            }
+        else:
+            result["sintel"] = {"skipped": f"no frames in '{data_dir}'"}
+        _emit(result)
+
+
 def _bench_dicl():
     """Matching-phase breakdown (``BENCH_DICL=1``): window-sample ms (XLA
     gather vs fused Pallas sampler) and matching-net ms (per-level loop vs
@@ -1286,6 +1464,20 @@ def main():
         from raft_meets_dicl_tpu import telemetry
         telemetry.activate(telemetry.create())
         _bench_ladder()
+        return
+
+    if os.environ.get("BENCH_VIDEO", "0") != "0":
+        # streaming-video warm-start: cold vs warm frames/s + EPE on
+        # synthetic constant-motion sequences, plus fw/bw products.
+        # Persistent cache on: the warm-start claim is about iterations
+        # per frame, not compiles.
+        from raft_meets_dicl_tpu.utils.compcache import (
+            enable_persistent_cache,
+        )
+        enable_persistent_cache()
+        from raft_meets_dicl_tpu import telemetry
+        telemetry.activate(telemetry.create())
+        _bench_video()
         return
 
     if os.environ.get("BENCH_DICL", "0") != "0":

@@ -513,9 +513,9 @@ class CostReport:
 
 def build_entries(include_mesh2d=True, shape=(48, 64)):
     """The audited program set: the flagship tiny-shape train/eval pair,
-    the (4, 2)-mesh ZeRO SPMD variant (8 virtual devices), and every
-    iteration-ladder rung — exactly the programs ``hlo-budget.json``
-    pins."""
+    the (4, 2)-mesh ZeRO SPMD variant (8 virtual devices), every
+    iteration-ladder rung, and the video warm-start variant — exactly
+    the programs ``hlo-budget.json`` pins."""
     import jax
 
     from . import hlo
@@ -525,6 +525,7 @@ def build_entries(include_mesh2d=True, shape=(48, 64)):
         entries += hlo.build_flagship_programs(n_devices=8, shape=shape,
                                                mesh2d=True)
     entries += hlo.build_ladder_programs()
+    entries += hlo.build_warm_programs()
     return entries
 
 

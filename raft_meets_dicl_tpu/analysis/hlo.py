@@ -339,6 +339,61 @@ def build_ladder_programs(rungs=(2, 4, 6), shape=(48, 64), batch=1,
     return entries
 
 
+def build_warm_programs(rungs=(2, 4, 6), shape=(48, 64), batch=1,
+                        mixed_precision=True):
+    """Register the video warm-start program variants of the ladder-audit
+    model and return ``[(program, args, audit_kwargs)]`` for auditing.
+
+    The warm-start contract the audit pins: each rung has at most *one*
+    warm variant — one registered program per (rung, warm) pair, keyed
+    only by the added ``warm`` flag, so the plain ladder keys (and their
+    pinned budgets) are untouched; each lowers fingerprint-stably; and
+    the in-program forward projection does not break the bf16 policy
+    (no f32 convolutions). The cost delta vs. the plain rung — the
+    projection's gather/compare overhead — is pinned by graftcost.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from .. import evaluation, models
+    from ..serve.ladder import LadderSpec
+
+    cfg = {
+        "name": "ladder audit", "id": "ladder-audit",
+        "model": {"type": "raft/baseline",
+                  "parameters": {"corr-levels": 2, "corr-radius": 2,
+                                 "corr-channels": 32,
+                                 "context-channels": 16,
+                                 "recurrent-channels": 16,
+                                 "mixed-precision": mixed_precision}},
+        "loss": {"type": "raft/sequence"},
+        "input": {"padding": {"type": "modulo", "mode": "zeros",
+                              "size": [8, 8]}},
+    }
+    spec = models.load(cfg)
+    model = spec.model
+    h, w = shape
+    rng = np.random.RandomState(0)
+    img1 = jnp.asarray(rng.rand(batch, h, w, 3).astype(np.float32))
+    img2 = jnp.asarray(rng.rand(batch, h, w, 3).astype(np.float32))
+    variables = model.init(jax.random.PRNGKey(0), img1, img2, iterations=1)
+
+    lad = LadderSpec(rungs=rungs)
+    # the carry a warm program consumes is the coarse-grid flow the
+    # plain base rung produces — run it once for a correctly-shaped
+    # example arg
+    base = evaluation.make_rung_fn(model, lad.rungs[0], model_id=spec.id)
+    _, state = base(variables, img1, img2)
+
+    kwargs = {"expect_bf16": mixed_precision, "n_devices": 1}
+    entries = []
+    warm = evaluation.make_warm_fn(model, lad.rungs[0], model_id=spec.id)
+    entries.append((warm, (variables, img1, img2, state["flow"]),
+                    dict(kwargs)))
+    return entries
+
+
 def audit_registry(entries=None, **build_kwargs):
     """Audit every (program, args, kwargs) entry; defaults to the
     flagship tiny-shape build. Returns ``(reports, findings)``."""

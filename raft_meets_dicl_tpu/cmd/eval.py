@@ -20,7 +20,11 @@ FLOW_FORMATS = (
     "flow:flo", "flow:kitti", "visual:epe", "visual:bp-fl", "visual:flow",
     "visual:flow:dark", "visual:flow:gt", "visual:i1",
     "visual:warp:backwards", "visual:intermediate:flow",
+    "visual:occlusion", "visual:confidence",
 )
+
+# formats derived from the forwards-backwards pass (--fwbw)
+_FWBW_FORMATS = ("visual:occlusion", "visual:confidence")
 
 
 def evaluate(args):
@@ -32,6 +36,12 @@ def evaluate(args):
             f"unknown flow format '{args.flow_format}'; "
             f"choose one of {', '.join(FLOW_FORMATS)}"
         )
+
+    fwbw = bool(getattr(args, "fwbw", False))
+    if args.flow and args.flow_format in _FWBW_FORMATS and not fwbw:
+        raise ValueError(
+            f"flow format '{args.flow_format}' derives from the "
+            f"forwards-backwards pass; add --fwbw")
 
     # telemetry (opt-in for eval: --telemetry PATH): the sweep's eval
     # event, compile attribution, and the AOT hit/miss trail
@@ -103,6 +113,13 @@ def evaluate(args):
     if wire is not None:
         wire = wire.bound(input.clip, input.range)
         logging.info(f"input wire format: {wire.describe()}")
+
+    if fwbw and wire is not None:
+        # the backwards dispatch re-enters the eval program with the
+        # yielded (already host-decoded) images; a wire session would
+        # need them re-encoded — keep the product path f32-only
+        raise ValueError("--fwbw needs the plain f32 input path "
+                         "(drop --wire-format)")
 
     # shape buckets: quantize mixed per-image resolutions onto a small
     # canonical set and batch same-bucket samples — a KITTI-like sweep
@@ -209,6 +226,9 @@ def evaluate(args):
 
     import json
 
+    if fwbw:
+        from ..video.products import fw_bw_products
+
     output = []
     ctx_m = metrics.MetricContext()
 
@@ -220,6 +240,16 @@ def evaluate(args):
         est = sample.final[None]
         out = model_adapter.wrap_result(sample.output, None)
 
+        occlusion = confidence = None
+        if fwbw:
+            # reversed pair through the same compiled eval program
+            # (batch 1 — a second shape next to a batched sweep, but
+            # one compile per bucket, and products stay per-sample)
+            _, flow_bw = eval_fn(variables, sample.img2[None],
+                                 sample.img1[None])
+            flow_bw = np.asarray(jax.device_get(flow_bw))[0]
+            occlusion, confidence = fw_bw_products(sample.final, flow_bw)
+
         if target is not None and compute_metrics:
             sample_loss = float(np.asarray(
                 loss(model, out.output(), target, valid)
@@ -227,6 +257,11 @@ def evaluate(args):
             sample_metrs = mtx(ctx_m, est, target, valid, sample_loss)
 
             record = {"id": str(sample.meta.sample_id), "metrics": sample_metrs}
+            if occlusion is not None:
+                record["fwbw"] = {
+                    "occlusion_ratio": round(float(occlusion.mean()), 5),
+                    "confidence_mean": round(float(confidence.mean()), 5),
+                }
             output.append(record)
             collectors.collect(sample_metrs)
             if inc_fd is not None:
@@ -245,7 +280,7 @@ def evaluate(args):
                 path_flow, args.flow_format, sample.meta.sample_id, img1, img2,
                 sample.target, sample.valid, sample.final, out,
                 sample.meta.original_extents, visual_args, visual_dark_args,
-                epe_args,
+                epe_args, occlusion=occlusion, confidence=confidence,
             )
 
     if inc_fd is not None:
@@ -277,13 +312,16 @@ def evaluate(args):
 
 def save_flow_image(dir, format, sample_id, img1, img2, target, valid, flow,
                     out, size, visual_args, visual_dark_args, epe_args,
-                    batch_index=0):
+                    batch_index=0, occlusion=None, confidence=None):
     """One sample's output in the requested format (src/cmd/eval.py:274-303).
 
     ``batch_index`` selects the sample within ``out``'s batch dimension
     for the intermediates dump — the evaluation generator yields
     per-sample (batch-1) outputs, so the default 0 addresses that sample;
     callers holding a full-batch result pass the real index.
+    ``occlusion``/``confidence`` are the forwards-backwards products
+    (``--fwbw``), required by the ``visual:occlusion`` and
+    ``visual:confidence`` formats.
     """
     (h0, h1), (w0, w1) = size
     flow = flow[h0:h1, w0:w1]
@@ -293,6 +331,10 @@ def save_flow_image(dir, format, sample_id, img1, img2, target, valid, flow,
         target = target[h0:h1, w0:w1]
     if valid is not None:
         valid = np.asarray(valid[h0:h1, w0:w1], bool)
+    if occlusion is not None:
+        occlusion = occlusion[h0:h1, w0:w1]
+    if confidence is not None:
+        confidence = confidence[h0:h1, w0:w1]
 
     formats = {
         "flow:flo": (data.io.write_flow_mb, [flow], {}, "flo"),
@@ -306,6 +348,10 @@ def save_flow_image(dir, format, sample_id, img1, img2, target, valid, flow,
         "visual:warp:backwards": (save_flow_visual_warp_backwards, [img2, flow], {}, "png"),
         "visual:intermediate:flow": (save_intermediate_flow_visual,
                                      [out, batch_index], visual_args, "png"),
+        "visual:occlusion": (save_occlusion_visual, [img1, occlusion],
+                             {}, "png"),
+        "visual:confidence": (save_confidence_visual, [confidence],
+                              {}, "png"),
     }
 
     write, wargs, kwargs, ext = formats[format]
@@ -348,6 +394,16 @@ def save_flow_visual_fl_error(path, uv, uv_target, mask):
 
 def save_flow_visual_warp_backwards(path, img2, flow):
     cv2.imwrite(str(path), _to_u8(visual.warp_backwards(img2, flow)[:, :, ::-1]))
+
+
+def save_occlusion_visual(path, img1, occlusion, **kwargs):
+    rgba = visual.occlusion_overlay(img1, occlusion, **kwargs)
+    cv2.imwrite(str(path), _to_u8(visual.utils.rgba_to_bgra(rgba)))
+
+
+def save_confidence_visual(path, confidence, **kwargs):
+    rgba = visual.confidence_to_rgba(confidence, **kwargs)
+    cv2.imwrite(str(path), _to_u8(visual.utils.rgba_to_bgra(rgba)))
 
 
 def save_intermediate_flow_visual(path, output, batch_index=0, mrm=None,

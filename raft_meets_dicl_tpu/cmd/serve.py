@@ -125,9 +125,15 @@ def serve(args):
                 "ladder-threshold", None))
         logging.info(f"iteration ladder: {ladder.describe()}")
 
+    video = bool(_pick(getattr(args, "video", None) or None, cfg,
+                       "video", None))
+    if video:
+        logging.info("video sessions enabled: warm-start programs + "
+                     "sticky per-client carry cache")
+
     session = serving.ServeSession(
         spec, buckets, wire=wire, checkpoint=checkpoint,
-        batch_size=batch_size, ladder=ladder)
+        batch_size=batch_size, ladder=ladder, video=video)
 
     outcomes = session.warm_pool()
     for o in outcomes:
@@ -175,12 +181,17 @@ def serve(args):
     requests = int(_pick(args.requests, cfg, "requests", 32))
     rate = float(_pick(args.rate, cfg, "rate", 50.0))
     classes = list(serving.CLASSES) if ladder is not None else None
+    if video:
+        # sticky streams force the fast rung; class cycling is moot
+        classes = None
     logging.info(f"open-loop load: {requests} requests at {rate}/s over "
                  f"{len(shapes)} shapes"
-                 + (f", classes {'/'.join(classes)}" if classes else ""))
+                 + (f", classes {'/'.join(classes)}" if classes else "")
+                 + (", sticky video streams" if video else ""))
 
     report = serving.loadgen.run_open_loop(
-        scheduler, shapes, requests=requests, rate_hz=rate, classes=classes)
+        scheduler, shapes, requests=requests, rate_hz=rate, classes=classes,
+        sequence=video)
     if scheduler.slo:
         report["slo"] = scheduler.slo.snapshot()
     tail = scheduler.trace_summary.tail()

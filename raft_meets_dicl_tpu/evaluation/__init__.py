@@ -383,6 +383,111 @@ def make_rung_fn(model, iterations, cont=False, mesh=None, wire=None,
     return _cache(step)
 
 
+def make_warm_fn(model, iterations, mesh=None, wire=None,
+                 variables_sharding=None, model_id=None, model_args=None):
+    """Registered temporal warm-start program for video sequences:
+    ``(variables, img1, img2, flow) -> (final_flow, state)`` where
+    ``flow`` is the *previous frame's* coarse flow (the ``state["flow"]``
+    carry of any rung/warm program, unfetched).
+
+    The previous flow is forward-projected to the current frame *inside*
+    the program — ``warp_backwards(flow, -flow)`` approximates the
+    forward splat as ``out(p) = flow(p - flow(p))`` with out-of-frame
+    pixels masked to zero flow — and fed into ``flow_init``. The GRU
+    hidden state is *not* re-initialised here (``hidden_init`` from a
+    fresh context would break parity; cross-frame hidden carry rides the
+    existing ``cont=True`` rung programs instead), so with ``flow=0`` the
+    projection is exactly zero and the program is bit-exact vs the plain
+    base rung — cache misses degrade to the cold path, never a different
+    answer.
+
+    Each (iterations, warm) pair is its own ``ProgramKey`` flag variant
+    of kind ``rung_step`` (the ``warm=True`` flag is only present on
+    warm programs, so existing rung keys/AOT artifacts/budget pins are
+    untouched); warm programs dedupe, AOT-export, and prefetch like any
+    rung, and ``serve --prebuild`` covers them via ``warm_pool()``.
+    """
+    from .. import compile as programs
+    from ..ops import warp
+    from ..parallel import partition
+
+    iterations = int(iterations)
+    model_args = dict(model_args or {})
+    for reserved in ("iterations", "flow_init", "hidden_init",
+                     "return_state"):
+        model_args.pop(reserved, None)
+
+    base = _cache_key(model, model_args, mesh, wire, variables_sharding)
+    key = None if base is None else ("rung", iterations, "warm") + base
+    if key is not None and key in _EVAL_FN_CACHE:
+        return _EVAL_FN_CACHE[key]
+
+    def _cache(step):
+        if key is not None:
+            while len(_EVAL_FN_CACHE) >= _EVAL_FN_CACHE_MAX:
+                _EVAL_FN_CACHE.pop(next(iter(_EVAL_FN_CACHE)))
+            _EVAL_FN_CACHE[key] = step
+        return step
+
+    pkey = None
+    args_key = static_args_key(
+        dict(getattr(model, "arguments", {})) | model_args)
+    if args_key is not None and variables_sharding is None:
+        mesh_key = (None if mesh is None
+                    else tuple(d.id for d in mesh.devices.flat))
+        wire_key = None if wire is None else (
+            wire.images, wire.flow, wire.pack_valid, wire.clip, wire.range)
+        pkey = programs.ProgramKey(
+            kind="rung_step",
+            model=model_id or programs.unstable(model),
+            flags=programs.flag_items(
+                args=args_key, iterations=iterations, cont=False,
+                warm=True, mesh=mesh_key, wire=wire_key))
+        existing = programs.registry().get(pkey)
+        if existing is not None:
+            return _cache(existing)
+
+    adapter = model.get_adapter()
+    gather = (mesh is not None and variables_sharding is not None
+              and partition.is_sharded(variables_sharding))
+    repl_one = partition.replicated(mesh) if mesh is not None else None
+
+    forward_args = dict(model_args)
+    forward_args["iterations"] = iterations
+    forward_args["return_state"] = True
+
+    def step(variables, img1, img2, flow):
+        if gather:
+            variables = jax.lax.with_sharding_constraint(
+                variables, repl_one)
+        if wire is not None:
+            img1, img2, _, _ = wire.decode(img1, img2)
+        flow = flow.astype(jnp.float32)
+        init, _ = warp.warp_backwards(flow, -flow)
+        kwargs = dict(forward_args)
+        kwargs["flow_init"] = init
+        out, state = model.apply(variables, img1, img2, train=False,
+                                 **kwargs)
+        result = adapter.wrap_result(out, img1.shape[1:3])
+        return result.final(), state
+
+    if mesh is None:
+        step = jax.jit(step)
+    else:
+        data = partition.data_sharding(mesh)
+        variables_in = (variables_sharding if variables_sharding is not None
+                        else partition.replicated(mesh))
+        step = jax.jit(step, in_shardings=(variables_in, data, data, data))
+
+    step = programs.register_step("rung_step", step, key=pkey)
+    step._refs = (model,)
+    step.iterations = iterations
+    step.cont = False
+    step.warm = True
+
+    return _cache(step)
+
+
 def _program_compile_counter(step):
     """Monotone compile counter for one step callable.
 

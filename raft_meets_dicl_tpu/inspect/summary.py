@@ -719,9 +719,15 @@ class SummaryInspector(Inspector):
             self._post_validation(log, ctx)
 
 
-def write_images(writer, pfx, i, img1, img2, target, estimate, valid, meta, step):
+def write_images(writer, pfx, i, img1, img2, target, estimate, valid, meta,
+                 step, occlusion=None, confidence=None):
     """Un-pad, color-code, and write one sample's images to TB
-    (src/inspect/summary.py:666-705). Inputs are NHWC host arrays."""
+    (src/inspect/summary.py:666-705). Inputs are NHWC host arrays.
+
+    ``occlusion``/``confidence`` are optional forwards-backwards product
+    maps (NHW); when provided they are written as extra images under the
+    same prefix, so existing TB mirrors see exactly the original four
+    tags unless a caller opts in."""
     (h0, h1), (w0, w1) = meta[i].original_extents
 
     i1 = (np.asarray(img1[i]) + 1.0) / 2.0
@@ -753,3 +759,14 @@ def write_images(writer, pfx, i, img1, img2, target, estimate, valid, meta, step
     writer.add_image(f"{pfx}img2", i2, step, dataformats="HWC")
     writer.add_image(f"{pfx}flow-gt", ft, step, dataformats="HWC")
     writer.add_image(f"{pfx}flow-est", fe, step, dataformats="HWC")
+
+    if occlusion is not None:
+        occ = np.asarray(occlusion[i], bool)[h0:h1, w0:w1]
+        rgba = visual.occlusion_overlay(i1, occ)
+        writer.add_image(f"{pfx}fwbw-occlusion", rgba, step,
+                         dataformats="HWC")
+    if confidence is not None:
+        conf = np.asarray(confidence[i])[h0:h1, w0:w1]
+        rgba = visual.confidence_to_rgba(conf)
+        writer.add_image(f"{pfx}fwbw-confidence", rgba, step,
+                         dataformats="HWC")

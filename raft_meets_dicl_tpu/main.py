@@ -174,6 +174,12 @@ def main():
                        help="transform for visual:flow:dark image output")
     eval_.add_argument("--flow-only", action="store_true",
                        help="only compute flow images, do not evaluate metrics")
+    eval_.add_argument("--fwbw", action="store_true",
+                       help="also run the reversed pair per sample and "
+                            "derive forwards-backwards consistency "
+                            "products (occlusion masks + confidence; "
+                            "enables the visual:occlusion and "
+                            "visual:confidence flow formats)")
     eval_.add_argument("--epe-cmap", default="gray",
                        help="colormap for end-point-error visualization")
     eval_.add_argument("--epe-max", type=float, default=None,
@@ -245,6 +251,14 @@ def main():
                        help="flow-delta norm below which the balanced "
                             "class stops escalating (also: "
                             "RMD_LADDER_THRESHOLD) [default: 0.1]")
+    serve.add_argument("--video", action="store_true",
+                       help="video sessions: register the warm-start "
+                            "program per bucket, cache per-client carry "
+                            "state (bounded + TTL-evicted), and route "
+                            "sequence requests onto it; the built-in "
+                            "client then submits sticky frame streams "
+                            "(also: the config's 'video' key) "
+                            "[default: off]")
     serve.add_argument("--prebuild", action="store_true",
                        help="compile + AOT-export every (model, bucket, "
                             "wire) program triple — with --ladder, every "

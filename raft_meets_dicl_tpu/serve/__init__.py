@@ -20,6 +20,14 @@ scheduler with bounded-queue admission control:
 - :mod:`.observe` — the live observability plane (PR 13): /metrics
   (Prometheus text), /healthz readiness+liveness, /statusz snapshots,
   /profilez on-demand profiler captures.
+
+Video streams (PR 15) ride the same path: a ``video=True`` session adds
+the registered warm-start program per bucket, the scheduler keys each
+client's previous-frame carry in a bounded TTL-evicted
+:class:`~..video.SessionCache`, and ``submit(sequence=True)`` requests
+coalesce on their own lanes onto the warm program (``products=True``
+adds fw/bw occlusion + confidence from a same-program reversed
+dispatch).
 """
 
 from . import batcher, ladder, loadgen, observe, scheduler, session

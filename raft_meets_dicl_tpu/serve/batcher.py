@@ -48,7 +48,11 @@ class ServeError(RuntimeError):
     - ``decode`` — the request failed while its batch was being
       prepared/decoded (the rest of the batch is unaffected);
     - ``internal`` — the dispatch failed; the batch's requests all carry
-      this error, the loop continues.
+      this error, the loop continues;
+    - ``unknown_class`` — the latency class does not exist (or the
+      session has no ladder);
+    - ``no_video`` — a sequence request reached a session built without
+      video support (``serve --video``).
     """
 
     def __init__(self, kind, detail=""):
@@ -78,6 +82,8 @@ class FlowRequest:
     t_submit: float
     t_enqueue: float = 0.0
     klass: str = ""  # latency class ("" = plain eval, no ladder)
+    sequence: bool = False  # video-session member (warm-start eligible)
+    products: bool = False  # also wants fw/bw occlusion + confidence
     spans: Dict[str, float] = field(default_factory=dict)
     trace: Any = None  # telemetry.trace.RequestTrace (None = untraced)
 
@@ -98,16 +104,20 @@ class FlowResult:
     spans: Dict[str, float]
     klass: str = ""
     iterations: int = 0  # recurrence iterations actually executed
+    warm: bool = False   # video session: started from a cached carry
+    occlusion: Optional[np.ndarray] = None   # fw/bw products (H, W) bool
+    confidence: Optional[np.ndarray] = None  # fw/bw products (H, W) f32
 
 
 class BucketBatcher:
     """Bounded per-lane FIFO queues + deterministic batch selection.
 
-    A lane is ``(bucket, klass)`` — requests only coalesce with
-    same-bucket, same-latency-class neighbors, so every dispatched batch
-    runs one ladder policy end to end. Without a ladder every request
-    carries the empty class and lanes degenerate to plain per-bucket
-    queues.
+    A lane is ``(bucket, klass, sequence)`` — requests only coalesce
+    with same-bucket, same-latency-class, same-sequence-ness neighbors,
+    so every dispatched batch runs one ladder policy (or the video
+    warm-start program) end to end. Without a ladder or video sessions
+    every request carries the empty class and lanes degenerate to plain
+    per-bucket queues.
 
     Selection policy (documented because tests pin it): full batches
     first — among lanes holding at least ``batch_size`` requests, the
@@ -128,7 +138,7 @@ class BucketBatcher:
         self.buckets = buckets
         self.batch_size = int(batch_size)
         self.queue_limit = int(queue_limit)
-        self._queues = {(b, ""): deque() for b in buckets.sizes}
+        self._queues = {(b, "", False): deque() for b in buckets.sizes}
 
     def assign(self, h, w) -> Optional[Tuple[int, int]]:
         """Smallest bucket fitting (h, w), or None (oversized)."""
@@ -142,7 +152,8 @@ class BucketBatcher:
 
     def offer(self, request) -> bool:
         """Enqueue, or refuse (lane queue at bound — backpressure)."""
-        lane = (request.bucket, getattr(request, "klass", ""))
+        lane = (request.bucket, getattr(request, "klass", ""),
+                getattr(request, "sequence", False))
         q = self._queues.setdefault(lane, deque())
         if len(q) >= self.queue_limit:
             return False
@@ -154,13 +165,16 @@ class BucketBatcher:
         return sum(len(q) for q in self._queues.values())
 
     def depths(self) -> Dict[str, int]:
-        """Per-lane queue depths keyed ``HxW/klass`` (klass omitted for
-        the empty ladderless class) — the /statusz live snapshot."""
+        """Per-lane queue depths keyed ``HxW[/klass][/seq]`` (klass
+        omitted for the empty ladderless class, ``/seq`` marking video
+        session lanes) — the /statusz live snapshot."""
         out = {}
-        for (bucket, klass), q in sorted(self._queues.items()):
+        for (bucket, klass, sequence), q in sorted(self._queues.items()):
             name = f"{bucket[0]}x{bucket[1]}"
             if klass:
                 name = f"{name}/{klass}"
+            if sequence:
+                name = f"{name}/seq"
             out[name] = len(q)
         return out
 

@@ -26,15 +26,20 @@ def synthetic_pair(shape, rng):
 
 
 def run_open_loop(scheduler, shapes, requests, rate_hz, client="loadgen",
-                  seed=0, result_timeout_s=120.0, classes=None):
+                  seed=0, result_timeout_s=120.0, classes=None,
+                  sequence=False, streams=4):
     """Drive ``scheduler`` with ``requests`` submissions at ``rate_hz``.
 
     ``shapes`` is the (H, W) cycle the stream draws from (mixed
     resolutions exercise bucket quantization and partial batches);
     ``classes`` an optional latency-class cycle (ladder sessions) — the
-    report then carries a per-class latency/rung breakdown. Returns the
-    report dict (see ``summarize``); deterministic for a fixed seed,
-    shape list, and class list.
+    report then carries a per-class latency/rung breakdown. With
+    ``sequence=True`` (video sessions) requests are submitted as
+    ``streams`` interleaved sticky client streams — each stream pins one
+    shape so its frames share a bucket and its carry stays valid — and
+    the report carries a warm-hit breakdown. Returns the report dict
+    (see ``summarize``); deterministic for a fixed seed, shape list, and
+    class list.
     """
     rng = np.random.default_rng(seed)
     interval = 1.0 / float(rate_hz)
@@ -48,11 +53,18 @@ def run_open_loop(scheduler, shapes, requests, rate_hz, client="loadgen",
         delay = target - time.perf_counter()
         if delay > 0:
             time.sleep(delay)
-        img1, img2 = synthetic_pair(shapes[i % len(shapes)], rng)
+        if sequence:
+            stream = i % max(1, int(streams))
+            shape = shapes[stream % len(shapes)]
+            name = f"{client}-{stream}"
+        else:
+            shape = shapes[i % len(shapes)]
+            name = client
+        img1, img2 = synthetic_pair(shape, rng)
         klass = classes[i % len(classes)] if classes else None
         try:
-            tickets.append(scheduler.submit(img1, img2, client=client,
-                                            klass=klass))
+            tickets.append(scheduler.submit(img1, img2, client=name,
+                                            klass=klass, sequence=sequence))
         except ServeRejected as e:
             rejects[e.reason] = rejects.get(e.reason, 0) + 1
         except ServeError as e:
@@ -112,4 +124,9 @@ def summarize(requests, results, rejects, errors, wall_s):
                 "iterations": dict(sorted(c["iterations"].items())),
             } for k, c in sorted(by_class.items())
         }
+
+    # video breakdown: warm-start hit ratio across completed frames
+    warm = sum(1 for r in results if getattr(r, "warm", False))
+    if warm:
+        report["video"] = {"warm": warm, "cold": completed - warm}
     return report

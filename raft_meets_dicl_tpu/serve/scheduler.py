@@ -19,6 +19,17 @@ invariants the tests pin:
   still in flight is held until the predecessor completes, so clients
   can stream results without reordering buffers.
 
+With a video session (``ServeSession(video=True)``) a client id is also
+a *sticky video session*: ``submit(..., sequence=True)`` requests ride
+their own batcher lanes onto the warm-start program, seeded per member
+from the bounded TTL-evicted :class:`~..video.SessionCache` (previous
+frame's coarse carry, keyed by client). A member without a usable carry
+gets a zero row — bit-exact with the plain cold rung — so cache
+eviction and resolution switches degrade, never corrupt.
+``submit(..., products=True)`` additionally dispatches the batch's
+reversed pairs through the *same* compiled program (no new shapes) and
+attaches fw/bw occlusion masks + confidence to the result.
+
 This module is host-side only (no jax import — device work lives in the
 session); per-request telemetry lands as ``serve`` events: ``request``
 (success, with admission/queue/dispatch/device spans), ``error``,
@@ -124,6 +135,15 @@ class Scheduler:
         self._m_depth = reg.gauge(
             "rmd_serve_queue_depth", "queued requests across all lanes")
 
+        # video sessions: per-client warm-start carry, bounded + TTL
+        # (hits/misses/evictions surface as rmd_serve_session_* metrics)
+        self.sessions = None
+        self._carry_factor = None  # (fy, fx) image-to-coarse-grid ratio
+        if getattr(session, "video", False):
+            from ..video import SessionCache
+
+            self.sessions = SessionCache()
+
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._rid = 0
@@ -135,7 +155,8 @@ class Scheduler:
 
     # -- admission (caller threads) -----------------------------------------
 
-    def submit(self, img1, img2, client="default", klass=None):
+    def submit(self, img1, img2, client="default", klass=None,
+               sequence=False, products=False):
         """Admit one raw (un-normalized f32 HWC) image pair.
 
         ``klass`` picks the latency class (``ladder.CLASSES``) when the
@@ -143,12 +164,19 @@ class Scheduler:
         requests only batch with same-class neighbors. Without a ladder
         the class must stay unset.
 
+        ``sequence=True`` marks a video-session frame: the request is
+        warm-started from the client's cached carry and routed to the
+        fast rung (``klass`` is ignored — warm-start requests ride the
+        warm program by construction). Needs a video session.
+        ``products=True`` additionally returns fw/bw occlusion +
+        confidence on the result.
+
         Returns a :class:`Ticket` on acceptance. Raises synchronously:
         :class:`ServeError` (``malformed``/``oversized``/
-        ``unknown_class``) when the payload can never be served,
-        :class:`ServeRejected` (``queue_full``/``shutdown``) when the
-        system sheds it — admission is where backpressure surfaces, the
-        dispatch loop never blocks on overload.
+        ``unknown_class``/``no_video``) when the payload can never be
+        served, :class:`ServeRejected` (``queue_full``/``shutdown``)
+        when the system sheds it — admission is where backpressure
+        surfaces, the dispatch loop never blocks on overload.
         """
         t0 = time.perf_counter()
         with self._lock:
@@ -156,7 +184,18 @@ class Scheduler:
             self._rid += 1
 
         try:
-            klass = self._validate_klass(klass)
+            if sequence:
+                if self.sessions is None:
+                    raise ServeError(
+                        "no_video",
+                        "sequence requests need a video session "
+                        "(serve --video)")
+                # warm-start frames always enter at the fast rung; the
+                # warm program rides its own batcher lanes per bucket
+                klass = ("fast" if getattr(self.session, "ladder", None)
+                         is not None else "")
+            else:
+                klass = self._validate_klass(klass)
             self._validate(rid, img1, img2)
             h, w = int(img1.shape[0]), int(img1.shape[1])
             bucket = self.batcher.assign(h, w)
@@ -179,7 +218,9 @@ class Scheduler:
         rtrace.mark("submit", t0)
         req = FlowRequest(rid=rid, client=client, seq=0, bucket=bucket,
                           shape=(h, w), img1=e1, img2=e2, ticket=ticket,
-                          t_submit=t0, klass=klass, trace=rtrace)
+                          t_submit=t0, klass=klass,
+                          sequence=bool(sequence), products=bool(products),
+                          trace=rtrace)
 
         with self._cond:
             if self._stopping:
@@ -339,12 +380,34 @@ class Scheduler:
         img1, img2, fill = self.batcher.assemble(live)
         btrace.fill = fill
         c0 = self.session.compiles()
-        if klass:
+        sequence = live[0].sequence  # lanes are same-sequence-ness too
+        warm_rows = [None] * len(live)
+        state = None
+        if sequence:
+            carry, warm_rows = self._gather_carry(live, bucket, fill)
+            flow, state, info = self.session.run_video(img1, img2, carry)
+        elif klass:
             flow, info = self.session.run_ladder(img1, img2, klass)
         else:
             flow, info = self.session.run(img1, img2), None
+        products = any(r.products for r in live)
+        flow_bw = None
+        if products:
+            # fw/bw products: the reversed pairs ride the *same*
+            # compiled program (same shapes — zero new programs); video
+            # batches reverse cold, a carry has no meaning backwards
+            if sequence:
+                bw_dev, _, _ = self.session.run_video(img2, img1)
+            elif klass:
+                bw_dev, _ = self.session.run_ladder(img2, img1, klass)
+            else:
+                bw_dev = self.session.run(img2, img1)
         t1 = time.perf_counter()
         flow = self.session.fetch(flow)
+        if products:
+            flow_bw = self.session.fetch(bw_dev)
+        if sequence:
+            self._store_carry(live, bucket, state)
         t2 = time.perf_counter()
 
         tele = telemetry.get()
@@ -355,6 +418,12 @@ class Scheduler:
         if info is not None:
             batch_event.update(klass=klass, rungs=info["rungs"],
                                iterations=info["iterations"])
+        if sequence:
+            batch_event.update(
+                video=True,
+                warm_members=sum(1 for row in warm_rows if row is not None))
+        if products:
+            batch_event.update(products=True)
         tele.emit("serve", event="batch", **batch_event)
         btrace.finish()
         tele.emit("trace", event="batch", **btrace.record())
@@ -371,10 +440,61 @@ class Scheduler:
             if r.trace is not None:
                 r.trace.mark("launched", t1)
                 r.trace.mark("fetched", t2)
+            occ = conf = None
+            if r.products and flow_bw is not None:
+                from ..video.products import fw_bw_products
+
+                occ, conf = fw_bw_products(flow[i, :h, :w, :],
+                                           flow_bw[i, :h, :w, :])
             self._complete(r, result=FlowResult(
                 rid=r.rid, client=r.client, bucket=bucket, shape=r.shape,
                 flow=flow[i, :h, :w, :], spans=r.spans, klass=klass,
-                iterations=(info["iterations"] if info else 0)))
+                iterations=(info["iterations"] if info else 0),
+                warm=warm_rows[i] is not None,
+                occlusion=occ, confidence=conf))
+
+    # -- video session carry -------------------------------------------------
+
+    def _carry_shape(self, bucket):
+        """Expected coarse-carry row shape for ``bucket``, or None until
+        the model's downsampling factor has been observed (before any
+        video dispatch the cache is necessarily empty)."""
+        if self._carry_factor is None:
+            return None
+        fy, fx = self._carry_factor
+        return (int(round(bucket[0] / fy)), int(round(bucket[1] / fx)), 2)
+
+    def _gather_carry(self, live, bucket, fill):
+        """Per-member cached carries stacked into one batch array.
+
+        Members without a usable carry (new client, TTL-evicted,
+        resolution switch) get zero rows — the warm program is bit-exact
+        with the cold rung on zeros, so a partial-warm batch is always
+        correct. Returns ``(carry | None, per-member rows)``; None when
+        no member is warm (the batch runs the plain cold rung)."""
+        expected = self._carry_shape(bucket)
+        rows = [self.sessions.get(r.client, expected) for r in live]
+        have = [row for row in rows if row is not None]
+        if not have:
+            return None, rows
+        proto = have[0]
+        carry = np.stack([row if row is not None else np.zeros_like(proto)
+                          for row in rows])
+        if fill > 0:
+            carry = np.concatenate(
+                [carry, np.repeat(carry[-1:], fill, axis=0)])
+        return carry, rows
+
+    def _store_carry(self, live, bucket, state):
+        """Store each member's fresh coarse-flow carry for its client
+        (fill rows are dropped); the first store also pins the
+        image-to-coarse-grid factor the shape check needs."""
+        coarse = self.session.fetch(state["flow"])
+        if self._carry_factor is None:
+            self._carry_factor = (bucket[0] / coarse.shape[1],
+                                  bucket[1] / coarse.shape[2])
+        for i, r in enumerate(live):
+            self.sessions.put(r.client, coarse[i])
 
     # -- completion / sticky per-client release ------------------------------
 

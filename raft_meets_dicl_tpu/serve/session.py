@@ -33,7 +33,7 @@ class ServeSession:
     """
 
     def __init__(self, spec, buckets, wire=None, checkpoint=None,
-                 batch_size=4, mesh=None, ladder=None):
+                 batch_size=4, mesh=None, ladder=None, video=False):
         buckets = ShapeBuckets.from_config(buckets) \
             if not isinstance(buckets, ShapeBuckets) else buckets
         if buckets is None or not buckets.sizes:
@@ -73,6 +73,29 @@ class ServeSession:
                 self._rung_fns[(its, cont)] = evaluation.make_rung_fn(
                     self.model, its, cont=cont, mesh=mesh, wire=wire,
                     model_id=spec.id)
+
+        # video sessions (PR 15): one warm-start program per bucket set —
+        # the fast rung re-entered from the previous frame's carry (the
+        # projection lives inside the program; see make_warm_fn) — plus
+        # its plain-rung twin for cold frames. With a ladder the bottom
+        # rung doubles as the twin; ladderless sessions register one at
+        # RMD_VIDEO_WARM_ITERATIONS.
+        self.video = bool(video)
+        self._warm_fn = None
+        if video:
+            from ..utils import env
+
+            self.warm_iterations = (
+                ladder.rungs[0] if ladder is not None
+                else env.get_int("RMD_VIDEO_WARM_ITERATIONS"))
+            self._warm_fn = evaluation.make_warm_fn(
+                self.model, self.warm_iterations, mesh=mesh, wire=wire,
+                model_id=spec.id)
+            if (self.warm_iterations, False) not in self._rung_fns:
+                self._rung_fns[(self.warm_iterations, False)] = \
+                    evaluation.make_rung_fn(
+                        self.model, self.warm_iterations, mesh=mesh,
+                        wire=wire, model_id=spec.id)
 
     @classmethod
     def from_config(cls, model_cfg, buckets, **kwargs):
@@ -167,6 +190,32 @@ class ServeSession:
         jax.block_until_ready(flow)  # graftlint: disable=host-sync -- serving dispatch-span boundary
         return flow, {"rungs": rungs, "iterations": executed}
 
+    def run_video(self, img1, img2, carry=None):
+        """One video-session batch; returns ``(flow, state, info)``.
+
+        ``carry`` is the batch's previous-frame coarse flow (stacked
+        per-member rows from the scheduler's session cache) — the warm
+        program forward-projects it internally. ``carry=None`` runs the
+        plain rung twin: a true cold start, bit-exact with what the warm
+        program produces on an all-zero carry. ``state`` stays on device
+        except what the caller fetches; the scheduler stores its
+        ``flow`` rows back per client.
+        """
+        import jax
+
+        if not self.video:
+            raise RuntimeError("run_video needs a video=True session")
+        warm = carry is not None
+        if warm:
+            flow, state = self._warm_fn(self.variables, img1, img2, carry)
+        else:
+            flow, state = self._rung_fns[(self.warm_iterations, False)](
+                self.variables, img1, img2)
+        jax.block_until_ready(flow)  # graftlint: disable=host-sync -- serving dispatch-span boundary
+        return flow, state, {"rungs": 1,
+                             "iterations": self.warm_iterations,
+                             "warm": warm}
+
     def fetch(self, flow):
         """Device flow → host numpy (the per-request ``device`` span)."""
         import jax
@@ -175,9 +224,12 @@ class ServeSession:
 
     def compiles(self):
         """Exact backend-compile count across the serve programs — the
-        eval program plus every ladder rung (registry Program counters;
-        see evaluation._program_compile_counter)."""
+        eval program plus every ladder rung and the video warm variant
+        (registry Program counters; see
+        evaluation._program_compile_counter)."""
         progs = [self.eval_fn, *self._rung_fns.values()]
+        if self._warm_fn is not None:
+            progs.append(self._warm_fn)
         return sum(getattr(p, "compiles", 0) for p in progs)
 
     # -- warm pool ------------------------------------------------------------
@@ -230,30 +282,53 @@ class ServeSession:
             jax.block_until_ready(flow)  # graftlint: disable=host-sync -- warm pool must finish before serving starts
             _record(step, bucket, None, t0, c0, h0, s0)
 
-            if self.ladder is None:
-                continue
-            # ladder rungs: warm the base rung first, then feed its
-            # carry to every continuation increment (correct carry
-            # shapes without knowing the model's hidden width), then
-            # the monolithic full budget
-            lad = self.ladder
-            base = self._rung_fns[(lad.rungs[0], False)]
-            t0, c0, h0, s0 = _counts(base)
-            flow, state = base(self.variables, img, img)
-            jax.block_until_ready(flow)  # graftlint: disable=host-sync -- warm pool must finish before serving starts
-            _record(base, bucket, f"base:{lad.rungs[0]}", t0, c0, h0, s0)
-            for inc in sorted(set(lad.increments())):
-                step = self._rung_fns[(inc, True)]
-                t0, c0, h0, s0 = _counts(step)
-                flow, _ = step(self.variables, img, img,
-                               state["flow"], state["hidden"])
+            carry = None
+            if self.ladder is not None:
+                # ladder rungs: warm the base rung first, then feed its
+                # carry to every continuation increment (correct carry
+                # shapes without knowing the model's hidden width), then
+                # the monolithic full budget
+                lad = self.ladder
+                base = self._rung_fns[(lad.rungs[0], False)]
+                t0, c0, h0, s0 = _counts(base)
+                flow, state = base(self.variables, img, img)
                 jax.block_until_ready(flow)  # graftlint: disable=host-sync -- warm pool must finish before serving starts
-                _record(step, bucket, f"cont:+{inc}", t0, c0, h0, s0)
-            step = self._rung_fns[(lad.rungs[-1], False)]
+                _record(base, bucket, f"base:{lad.rungs[0]}", t0, c0, h0,
+                        s0)
+                carry = state
+                for inc in sorted(set(lad.increments())):
+                    step = self._rung_fns[(inc, True)]
+                    t0, c0, h0, s0 = _counts(step)
+                    flow, _ = step(self.variables, img, img,
+                                   state["flow"], state["hidden"])
+                    jax.block_until_ready(flow)  # graftlint: disable=host-sync -- warm pool must finish before serving starts
+                    _record(step, bucket, f"cont:+{inc}", t0, c0, h0, s0)
+                step = self._rung_fns[(lad.rungs[-1], False)]
+                t0, c0, h0, s0 = _counts(step)
+                flow, _ = step(self.variables, img, img)
+                jax.block_until_ready(flow)  # graftlint: disable=host-sync -- warm pool must finish before serving starts
+                _record(step, bucket, f"full:{lad.rungs[-1]}", t0, c0, h0,
+                        s0)
+
+            if not self.video:
+                continue
+            # video variants: the cold plain-rung twin (with a ladder the
+            # base rung above already covers it), then the warm-start
+            # program fed the twin's carry (correct coarse shape without
+            # knowing the model's downsampling factor)
+            if carry is None:
+                step = self._rung_fns[(self.warm_iterations, False)]
+                t0, c0, h0, s0 = _counts(step)
+                flow, carry = step(self.variables, img, img)
+                jax.block_until_ready(flow)  # graftlint: disable=host-sync -- warm pool must finish before serving starts
+                _record(step, bucket, f"base:{self.warm_iterations}", t0,
+                        c0, h0, s0)
+            step = self._warm_fn
             t0, c0, h0, s0 = _counts(step)
-            flow, _ = step(self.variables, img, img)
+            flow, _ = step(self.variables, img, img, carry["flow"])
             jax.block_until_ready(flow)  # graftlint: disable=host-sync -- warm pool must finish before serving starts
-            _record(step, bucket, f"full:{lad.rungs[-1]}", t0, c0, h0, s0)
+            _record(step, bucket, f"warm:{self.warm_iterations}", t0, c0,
+                    h0, s0)
         self.ready = True
         return outcomes
 

@@ -41,7 +41,7 @@ SCHEMA_VERSION = 1
 # readers keep working); a reader seeing ``v`` with the same major but a
 # larger fractional minor (e.g. 1.2 from a newer producer) should skip
 # the record, not reject the file — see :class:`NewerSchema`.
-SCHEMA_MINOR = 2
+SCHEMA_MINOR = 3
 
 # kind -> required payload fields (beyond the {v, t, kind} envelope).
 # Extra fields are allowed everywhere: the schema pins the floor a
@@ -127,6 +127,16 @@ SCHEMA = {
     # flight-recorder bundle written next to the emergency checkpoint
     # on crash / nonfinite escalation / SIGTERM (blackbox.dump)
     "postmortem": {"reason", "path"},
+    # streaming-video engine (PR 15): event is frame (one sequence-runner
+    # frame: warm/cold start, iterations spent, EPE when ground truth is
+    # known) | sequence (one finished sequence: frames, mean iterations,
+    # warm-hit ratio) | products (one fw/bw pass: occlusion ratio, mean
+    # confidence)
+    "video": {"event"},
+    # serve video-session cache (video.cache.SessionCache): event is
+    # hit (warm-start state served) | miss (cold start: absent, expired,
+    # or shape mismatch) | evict (capacity LRU or TTL expiry)
+    "session": {"event"},
 }
 
 

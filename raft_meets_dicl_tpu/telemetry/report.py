@@ -489,6 +489,70 @@ def serve_stats(events):
     }
 
 
+def video_stats(events):
+    """Aggregate the streaming-video plane (PR 15): ``video`` frame and
+    sequence events from the sequence runner / bench, ``session``
+    warm-start cache events, and the serving path's video batches."""
+    frames = []
+    sequences = []
+    sessions = {"hits": 0, "misses": 0, "evictions": {}}
+    session_seen = False
+    batches = {"batches": 0, "requests": 0, "warm": 0, "products": 0}
+    for e in events:
+        kind = e["kind"]
+        if kind == "video":
+            ev = e.get("event")
+            if ev == "frame":
+                frames.append(e)
+            elif ev == "sequence":
+                sequences.append(e)
+        elif kind == "session":
+            session_seen = True
+            ev = e.get("event")
+            if ev == "hit":
+                sessions["hits"] += 1
+            elif ev == "miss":
+                sessions["misses"] += 1
+            elif ev == "evict":
+                reason = e.get("reason", "?")
+                sessions["evictions"][reason] = \
+                    sessions["evictions"].get(reason, 0) + 1
+        elif (kind == "serve" and e.get("event") == "batch"
+                and e.get("video")):
+            batches["batches"] += 1
+            batches["requests"] += e.get("size", 0)
+            batches["warm"] += e.get("warm_members", 0)
+            if e.get("products"):
+                batches["products"] += 1
+    if not (frames or sequences or session_seen or batches["batches"]):
+        return None
+
+    def frame_summary(group):
+        if not group:
+            return None
+        its = [e.get("iterations", 0) for e in group]
+        epes = [e["epe"] for e in group if "epe" in e]
+        return {
+            "frames": len(group),
+            "mean_iterations": sum(its) / len(its),
+            "mean_epe": sum(epes) / len(epes) if epes else None,
+        }
+
+    return {
+        "warm": frame_summary([e for e in frames if e.get("warm")]),
+        "cold": frame_summary([e for e in frames if not e.get("warm")]),
+        "sequences": [{
+            "frames": s.get("frames", 0),
+            "warm_frames": s.get("warm_frames", 0),
+            "mean_iterations": s.get("mean_iterations", 0.0),
+            "frames_per_sec": s.get("frames_per_sec", 0.0),
+            "mean_epe": s.get("mean_epe"),
+        } for s in sequences],
+        "sessions": sessions if session_seen else None,
+        "batches": batches if batches["batches"] else None,
+    }
+
+
 def slo_stats(events):
     """Per-class SLO window summaries from the periodic ``slo`` events: the
     *latest* window per class (the current state) plus the worst burn
@@ -773,6 +837,44 @@ def render(events, errors=(), warmup_steps=DEFAULT_WARMUP_STEPS,
                 f"  warm pool {w['model']}[{w['bucket']}] ({w['wire']}"
                 f"{rung}): {w['compiles']} compiles, {w['aot_hits']} AOT "
                 f"hits, {w['aot_saves']} AOT saves")
+
+    video = video_stats(events)
+    if video:
+        lines.append("")
+        lines.append("== video ==")
+        for arm in ("cold", "warm"):
+            s = video[arm]
+            if not s:
+                continue
+            epe = (f", EPE {s['mean_epe']:.3f}"
+                   if s["mean_epe"] is not None else "")
+            lines.append(
+                f"{arm} frames: {s['frames']}, mean "
+                f"{s['mean_iterations']:.1f} iterations{epe}")
+        for s in video["sequences"]:
+            epe = (f", EPE {s['mean_epe']:.3f}"
+                   if s.get("mean_epe") is not None else "")
+            lines.append(
+                f"  sequence: {s['frames']} frames "
+                f"({s['warm_frames']} warm), "
+                f"{s['mean_iterations']:.1f} mean iterations, "
+                f"{s['frames_per_sec']:.2f} frames/s{epe}")
+        sess = video["sessions"]
+        if sess:
+            total = sess["hits"] + sess["misses"]
+            ratio = sess["hits"] / total * 100 if total else 0.0
+            evict = ", ".join(f"{r}={n}" for r, n in
+                              sorted(sess["evictions"].items()))
+            lines.append(
+                f"sessions: {sess['hits']} warm hits / {total} lookups "
+                f"({ratio:.0f}%)"
+                + (f", evictions {evict}" if evict else ""))
+        b = video["batches"]
+        if b:
+            lines.append(
+                f"serve batches: {b['batches']} video batches, "
+                f"{b['requests']} requests ({b['warm']} warm members, "
+                f"{b['products']} with fw/bw products)")
 
     traces = trace_stats(events)
     if traces:

@@ -196,6 +196,15 @@ KNOBS = dict([
        "(1-objective), >1 means the window misses it", "serve"),
     _k("RMD_SLO_WINDOW_S", "float", 60.0,
        "rolling SLO burn-rate window (seconds)", "serve"),
+    _k("RMD_VIDEO_SESSIONS", "int", 64,
+       "bounded per-client video session cache capacity in the serve "
+       "scheduler (LRU past it)", "serve"),
+    _k("RMD_VIDEO_SESSION_TTL_S", "float", 30.0,
+       "idle seconds before a video session's warm-start state is "
+       "TTL-evicted", "serve"),
+    _k("RMD_VIDEO_WARM_ITERATIONS", "int", 4,
+       "warm-start program iteration budget for ladderless video serve "
+       "sessions (with --ladder the bottom rung wins)", "serve"),
     # -- fault injection / harness -----------------------------------------
     _k("RMD_FAULT", "str", "",
        "deterministic fault injection spec (testing.faults)", "faults"),

@@ -1,0 +1,34 @@
+"""Streaming-video engine: temporal warm-start over frame sequences.
+
+The single-pair estimator becomes a video engine by composition:
+
+- ``warmstart`` — forward flow projection across frames (the host twin
+  of the projection baked into ``evaluation.make_warm_fn``'s registered
+  warm-start programs);
+- ``sequence`` — the sequence runner: full-budget cold frame 0, then
+  warm frames entering at the bottom ladder rung with the previous
+  frame's carry, escalating by the serve ladder's delta policy; plus
+  the doubled-batch fw/bw dispatch helper;
+- ``products`` — forwards-backwards consistency products (occlusion
+  masks + confidence) from fetched flow pairs, host-side numpy;
+- ``cache`` — the bounded, TTL-evicted per-client session store the
+  serve scheduler keys warm-start state on.
+"""
+
+from .cache import SessionCache
+from .products import fw_bw_products, fw_bw_products_batch, warp_flow
+from .sequence import (FrameResult, SequenceResult, SequenceRunner,
+                       fw_bw_flows)
+from .warmstart import project_flow
+
+__all__ = [
+    "SessionCache",
+    "fw_bw_products",
+    "fw_bw_products_batch",
+    "warp_flow",
+    "FrameResult",
+    "SequenceResult",
+    "SequenceRunner",
+    "fw_bw_flows",
+    "project_flow",
+]

@@ -1,0 +1,139 @@
+"""Bounded, TTL-evicted per-client video session state.
+
+A video stream served through the scheduler is a *sticky session*: the
+client id that already orders dispatches (PR 10 stickiness) also keys
+the warm-start state — the previous frame's coarse flow carry, left as
+the serve path fetched it. The cache is deliberately conservative:
+
+- **bounded** (``RMD_VIDEO_SESSIONS``, LRU past capacity) so a scrape of
+  short-lived clients cannot grow host memory without limit;
+- **TTL-evicted** (``RMD_VIDEO_SESSION_TTL_S``) so a stream that stalls
+  longer than the TTL restarts cold — stale motion is worse than no
+  prior;
+- **shape-checked** on lookup, so a client that switches resolution
+  mid-stream degrades to the cold path instead of feeding a mis-shaped
+  carry into a warm program.
+
+A miss of any kind returns None and the caller starts from zero flow —
+bit-exact with the plain program, so warm-start is purely an
+optimization, never a correctness hazard. Hits/misses/evictions are
+counted as ``rmd_serve_session_*`` metrics and ``session`` telemetry
+events.
+"""
+
+import threading
+import time
+
+from .. import telemetry
+from ..telemetry import metrics as metrics_mod
+from ..utils import env
+
+
+class SessionCache:
+    """Client-id-keyed warm-start store: ``put(client, flow)`` after a
+    frame completes, ``get(client, shape)`` before the next dispatch.
+
+    ``flow`` is whatever coarse-grid carry the serve path fetched
+    (host numpy); ``shape`` is the expected carry shape — a mismatch is
+    a miss. Thread-safe: the scheduler's dispatch loop and completion
+    callbacks touch it from different threads.
+    """
+
+    def __init__(self, capacity=None, ttl_s=None, clock=time.monotonic):
+        self.capacity = int(capacity if capacity is not None
+                            else env.get_int("RMD_VIDEO_SESSIONS"))
+        self.ttl_s = float(ttl_s if ttl_s is not None
+                           else env.get_float("RMD_VIDEO_SESSION_TTL_S"))
+        if self.capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {self.capacity}")
+        if self.ttl_s <= 0:
+            raise ValueError(f"ttl_s must be > 0, got {self.ttl_s}")
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._entries = {}  # client -> (flow, t_touch); dict order = LRU
+        reg = metrics_mod.registry()
+        self._m_hits = reg.counter(
+            "rmd_serve_session_warm_hits_total",
+            "video session lookups that served warm-start state")
+        self._m_misses = reg.counter(
+            "rmd_serve_session_misses_total",
+            "video session lookups that fell back to a cold start")
+        self._m_evictions = reg.counter(
+            "rmd_serve_session_evictions_total",
+            "video sessions dropped by TTL expiry or capacity LRU")
+        self._m_active = reg.gauge(
+            "rmd_serve_session_active", "live video sessions in the cache")
+
+    def __len__(self):
+        with self._lock:
+            return len(self._entries)
+
+    def _emit(self, event, client, **fields):
+        tele = telemetry.get()
+        if tele.enabled:
+            tele.emit("session", event=event, client=client, **fields)
+
+    def _expire_locked(self, now):
+        dead = [c for c, (_, t) in self._entries.items()
+                if now - t > self.ttl_s]
+        for c in dead:
+            del self._entries[c]
+        return dead
+
+    def get(self, client, shape=None):
+        """The client's cached carry flow, or None (cold start).
+
+        Expired entries are dropped on the way; a shape mismatch drops
+        the entry too (the old resolution's carry is useless now).
+        """
+        now = self._clock()
+        with self._lock:
+            expired = self._expire_locked(now)
+            entry = self._entries.pop(client, None)
+            if entry is not None and shape is not None \
+                    and tuple(entry[0].shape) != tuple(shape):
+                entry = None  # resolution switch: restart cold
+            if entry is not None:
+                # touch: re-insert at the MRU end
+                self._entries[client] = (entry[0], now)
+            active = len(self._entries)
+        for c in expired:
+            self._m_evictions.inc()
+            self._emit("evict", c, reason="ttl")
+        self._m_active.set(active)
+        if entry is None:
+            self._m_misses.inc()
+            self._emit("miss", client)
+            return None
+        self._m_hits.inc()
+        self._emit("hit", client)
+        return entry[0]
+
+    def put(self, client, flow):
+        """Store the just-completed frame's carry for the client."""
+        now = self._clock()
+        evicted = []
+        with self._lock:
+            expired = self._expire_locked(now)
+            self._entries.pop(client, None)
+            while len(self._entries) >= self.capacity:
+                lru = next(iter(self._entries))
+                del self._entries[lru]
+                evicted.append(lru)
+            self._entries[client] = (flow, now)
+            active = len(self._entries)
+        for c in expired:
+            self._m_evictions.inc()
+            self._emit("evict", c, reason="ttl")
+        for c in evicted:
+            self._m_evictions.inc()
+            self._emit("evict", c, reason="capacity")
+        self._m_active.set(active)
+
+    def drop(self, client):
+        """Explicitly end a session (stream closed)."""
+        with self._lock:
+            had = self._entries.pop(client, None) is not None
+            active = len(self._entries)
+        self._m_active.set(active)
+        return had

@@ -1,0 +1,47 @@
+"""Temporal warm-start helpers: forward flow projection across frames.
+
+A flow field estimated for frame pair (t-1, t) is a prior for pair
+(t, t+1) — but in the *previous* frame's coordinates. Following the
+RAFT lineage's warm-start mode (Teed & Deng 2020), the prior must move
+with the motion it describes before it can seed the next frame's
+recurrence. The exact forward splat scatters; on TPU we use the cheap
+backward-sampled approximation
+
+    out(p) = flow(p - flow(p))
+
+via the existing ``ops/warp`` machinery (``warp_backwards(flow, -flow)``
+— first-order equivalent for smooth motion), with out-of-frame samples
+masked to zero flow so disoccluded regions restart cold.
+
+Two call forms exist deliberately:
+
+- :func:`evaluation.make_warm_fn` bakes this projection *inside* the
+  registered warm-start program, so the serve path hands a raw cached
+  carry straight to the program (and ``flow=0`` stays bit-exact vs the
+  plain rung);
+- :func:`project_flow` here is the host-callable twin for flows already
+  living outside a program — the sequence runner's hidden-carry mode
+  feeds existing ``cont=True`` rung programs, which expect an
+  already-projected ``flow_init``.
+
+Zero flow is a fixed point of the projection (``flow(p - 0) = 0``), so
+both forms degrade to the cold zero-init path identically.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import warp
+
+
+@jax.jit
+def project_flow(flow):
+    """Forward-project a coarse flow field to the frame it points into.
+
+    flow: (B, H, W, 2) coarse-grid flow in coarse-pixel units. Returns
+    the projected field, zero where the backward sample leaves the
+    image (disocclusion: no prior is better than a stale one).
+    """
+    flow = flow.astype(jnp.float32)
+    projected, _ = warp.warp_backwards(flow, -flow)
+    return projected

@@ -3,7 +3,8 @@
 Capability parity with reference src/visual/__init__.py.
 """
 
-from . import bad_pixel, epe, flow_dark, flow_mb, imshow, utils, warp
+from . import (bad_pixel, epe, flow_dark, flow_mb, imshow, occlusion, utils,
+               warp)
 
 end_point_error = epe.end_point_error
 end_point_error_abs = epe.end_point_error_abs
@@ -11,14 +12,17 @@ fl_error = bad_pixel.fl_error
 flow_to_rgba = flow_mb.flow_to_rgba
 flow_to_rgba_dark = flow_dark.flow_to_rgba
 warp_backwards = warp.warp_backwards
+occlusion_overlay = occlusion.occlusion_overlay
+confidence_to_rgba = occlusion.confidence_to_rgba
 
 show_image = imshow.show_image
 show_flow = imshow.show_flow
 show_flow_dark = imshow.show_flow_dark
 
 __all__ = [
-    "bad_pixel", "epe", "flow_dark", "flow_mb", "imshow", "utils", "warp",
+    "bad_pixel", "epe", "flow_dark", "flow_mb", "imshow", "occlusion",
+    "utils", "warp",
     "end_point_error", "end_point_error_abs", "fl_error", "flow_to_rgba",
-    "flow_to_rgba_dark", "warp_backwards", "show_image", "show_flow",
-    "show_flow_dark",
+    "flow_to_rgba_dark", "warp_backwards", "occlusion_overlay",
+    "confidence_to_rgba", "show_image", "show_flow", "show_flow_dark",
 ]

@@ -271,8 +271,9 @@ def test_budget_pin_roundtrip_and_version_gate(tmp_path):
 @pytest.fixture(scope="module")
 def audited():
     """One shared audit pass over the full program set (flagship n=2,
-    the (4, 2)-mesh ZeRO variant, every ladder rung) against the
-    committed budget — the expensive compiles happen once per module."""
+    the (4, 2)-mesh ZeRO variant, every ladder rung, the video warm
+    variant) against the committed budget — the expensive compiles
+    happen once per module."""
     entries = cost.build_entries()
     budget = cost.Budget.load(REPO / cost.BUDGET_NAME)
     report = cost.audit_costs(entries=entries, budget=budget)
@@ -283,8 +284,10 @@ def test_budget_gate_green_on_committed_pins(audited):
     _, rep = audited
     assert rep.ok, cost.render_reports(rep)
     assert rep.stale == [], f"stale budget pins: {rep.stale}"
-    n = 7 if jax.device_count() >= 8 else 5
+    n = 8 if jax.device_count() >= 8 else 6
     assert len(rep.reports) == n
+    # the video warm-start variant is part of the audited set
+    assert any("'warm', 'True'" in r["key"] for r in rep.reports)
     # every audited program is pinned, and pinned exactly
     pinned = set(json.loads(
         (REPO / cost.BUDGET_NAME).read_text())["entries"])

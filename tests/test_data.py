@@ -394,6 +394,108 @@ def test_fill_avg_densifies():
     np.testing.assert_allclose(out[..., 1], -2.0)
 
 
+def test_drop_sequence_tails_forward_and_backward():
+    from raft_meets_dicl_tpu.data import dataset
+
+    A, B = ("a",), ("b",)
+    # scene A has an index gap (1,2 then 5,6); scene B is one run (1,2)
+    fwd = [(A, (), 1), (A, (), 2), (A, (), 5), (A, (), 6),
+           (B, (), 1), (B, (), 2)]
+    # every run's last frame has no (idx, idx+1) partner and is dropped
+    assert dataset._drop_sequence_tails(fwd, step=1) == [
+        (A, (), 1), (A, (), 5), (B, (), 1)]
+
+    # backwards layout sorts descending; the partner is (idx, idx-1), so
+    # the run's *lowest* index is the tail
+    bwd = sorted(fwd, key=lambda g: (g[0], g[1], -g[2]))
+    assert dataset._drop_sequence_tails(bwd, step=-1) == [
+        (A, (), 6), (A, (), 2), (B, (), 2)]
+
+    assert dataset._drop_sequence_tails([], step=1) == []
+    # a single frame has no partner in either direction
+    assert dataset._drop_sequence_tails([(A, (), 3)], step=1) == []
+
+
+class ConstFlowSource(Collection):
+    """Constant +3px horizontal translation with a consistent frame 2."""
+
+    type = "const-flow"
+
+    def __init__(self, n=2, h=20, w=24, shift=3):
+        self.n, self.h, self.w, self.shift = n, h, w, shift
+
+    def __getitem__(self, index):
+        rng = np.random.RandomState(index)
+        img1 = rng.rand(1, self.h, self.w, 3).astype(np.float32)
+        img2 = np.roll(img1, self.shift, axis=2)
+        flow = np.zeros((1, self.h, self.w, 2), np.float32)
+        flow[..., 0] = self.shift
+        valid = np.ones((1, self.h, self.w), dtype=bool)
+        meta = [Metadata(True, "const", SampleId("s{idx}", SampleArgs([], {"idx": index}),
+                                                 SampleArgs([], {"idx": index + 1})),
+                         ((0, self.h), (0, self.w)))]
+        return img1, img2, flow, valid, meta
+
+    def __len__(self):
+        return self.n
+
+    def get_config(self):
+        return {"type": "const-flow", "n": self.n}
+
+    def description(self):
+        return "const-flow"
+
+
+def test_estimate_backwards_flow_fill_densifies_disocclusions():
+    img1, img2, flow, valid, _ = ConstFlowSource()[0]
+
+    for method, args in (("minimum", {}), ("average", {"threshold": 1})):
+        flow_bw, valid_bw = fw_bw.estimate_backwards_flow(
+            img1[0], img2[0], flow[0], valid[0],
+            fill_method=method, fill_args=args)
+        assert valid_bw.all()
+        # the filled disocclusion strip inherits its valid neighbors'
+        # constant motion: exact inverse everywhere
+        np.testing.assert_allclose(flow_bw[..., 0], -3.0, atol=1e-5)
+        np.testing.assert_allclose(flow_bw[..., 1], 0.0, atol=1e-5)
+
+    with pytest.raises(ValueError):
+        fw_bw.estimate_backwards_flow(img1[0], img2[0], flow[0], valid[0],
+                                      fill_method="nearest")
+
+
+def test_fw_bw_estimate_collection():
+    src = ConstFlowSource(n=2)
+    est = fw_bw.ForwardsBackwardsEstimate(
+        src, {}, "average", {"threshold": 1})
+    assert len(est) == 2
+
+    img1, img2, flow, valid, meta = est[0]
+    s_img1, s_img2, s_flow, *_ = src[0]
+
+    # batch doubles: forward pairs then the swapped backward pairs
+    assert img1.shape[0] == 2 and img2.shape[0] == 2
+    np.testing.assert_array_equal(img1[0], s_img1[0])
+    np.testing.assert_array_equal(img1[1], s_img2[0])
+    np.testing.assert_array_equal(img2[1], s_img1[0])
+
+    # estimated backward half: exact inverse of the constant forward flow
+    np.testing.assert_array_equal(flow[0], s_flow[0])
+    np.testing.assert_allclose(flow[1], -s_flow[0], atol=1e-5)
+    assert valid.all()
+
+    assert meta[0].direction == "forwards"
+    assert meta[1].direction == "backwards"
+    assert meta[0].sample_id.format.endswith("-fwd")
+    assert meta[1].sample_id.format.endswith("-bwd")
+
+    cfg = est.get_config()
+    assert cfg["type"] == "forwards-backwards-estimate"
+    assert cfg["fill"] == {"method": "average",
+                           "parameters": {"threshold": 1}}
+    assert cfg["source"] == {"type": "const-flow", "n": 2}
+
+
 def test_fw_bw_batch_pairs():
     fwd, bwd = FakeSource(3), FakeSource(3)
 
