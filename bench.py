@@ -45,6 +45,44 @@ def _emit(result):
     return result
 
 
+def _profile_step(run):
+    """Measured graftprof attribution of one profiled step execution —
+    the per-op-class receipt every BENCH_* line carries (BENCH_PROFILE=0
+    disables). Advisory: returns an ``{"error": ...}`` stub instead of
+    raising, so a profiler/parser failure never loses the bench line."""
+    import shutil
+    import tempfile
+
+    from raft_meets_dicl_tpu.analysis import profile as prof
+
+    tmp = tempfile.mkdtemp(prefix="rmd-bench-prof-")
+    try:
+        jax.profiler.start_trace(tmp)
+        try:
+            out = run()
+            jax.block_until_ready(out)
+        finally:
+            jax.profiler.stop_trace()
+        summary = prof.attribute_trace(tmp)
+        classes = {}
+        for m in summary["modules"]:
+            for c, s in m["classes"].items():
+                classes[c] = round(classes.get(c, 0.0) + s, 6)
+        return {
+            "device_seconds": summary["device_seconds"],
+            "source": summary["source"],
+            "classes": dict(sorted(classes.items(),
+                                   key=lambda kv: -kv[1])),
+            "modules": [{"module": m["module"], "program": m["program"],
+                         "seconds": m["seconds"]}
+                        for m in summary["modules"][:4]],
+        }
+    except Exception as e:  # noqa: BLE001 - attribution is advisory
+        return {"error": f"{type(e).__name__}: {e}"}
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _measure(model_cfg, loss_cfg, batch, height, width, model_args, steps,
              nonfinite=None):
     """One synthetic training-step throughput measurement; all device
@@ -130,6 +168,11 @@ def _measure(model_cfg, loss_cfg, batch, height, width, model_args, steps,
                 dispatch[min(steps - 1, int(round(0.95 * (steps - 1))))]
                 * 1e3, 3),
         }
+        # measured device-time attribution (graftprof): one extra
+        # profiled step, parsed into per-op-class seconds
+        if os.environ.get("BENCH_PROFILE", "1") != "0":
+            summary["profile"] = _profile_step(
+                lambda: step(state, img1, img2, flow, valid))
 
     # peak_bytes_in_use is a process-lifetime high-water mark: meaningful
     # for the first measurement in a process, an upper bound afterwards

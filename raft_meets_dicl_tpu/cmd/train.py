@@ -529,6 +529,18 @@ def _train(args):
     finally:
         if profile_dir:
             jax.profiler.stop_trace()
+            # graftprof attribution of the capture: advisory — never
+            # let a parse failure mask the run's real exit path
+            if utils.env.get_bool("RMD_PROFILE_ATTRIBUTION"):
+                try:
+                    from ..analysis import profile as prof
+
+                    summary = prof.attribute_trace(profile_dir)
+                    log.info("profile attribution:\n"
+                             + prof.render_attribution(summary))
+                except Exception as e:  # noqa: BLE001 - attribution is advisory
+                    log.warn(f"profile attribution failed: "
+                             f"{type(e).__name__}: {e}")
         if observer is not None:
             observer.close()
         ledger = goodput.get()

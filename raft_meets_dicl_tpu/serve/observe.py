@@ -128,9 +128,11 @@ class Observer:
 
     def profile(self, seconds):
         """Capture ``seconds`` of jax profiler trace; returns the
-        directory holding the capture. Single-flight: a second request
-        while one runs gets a 409."""
-        return sidecar.capture_profile(self._profile_lock, seconds)
+        directory holding the capture plus an inline graftprof
+        attribution summary (``RMD_PROFILE_ATTRIBUTION``).
+        Single-flight: a second request while one runs gets a 409."""
+        return sidecar.capture_profile(self._profile_lock, seconds,
+                                       registry=self.registry)
 
 
 class ObserverServer(sidecar.SidecarServer):

@@ -41,7 +41,7 @@ SCHEMA_VERSION = 1
 # readers keep working); a reader seeing ``v`` with the same major but a
 # larger fractional minor (e.g. 1.2 from a newer producer) should skip
 # the record, not reject the file — see :class:`NewerSchema`.
-SCHEMA_MINOR = 3
+SCHEMA_MINOR = 4
 
 # kind -> required payload fields (beyond the {v, t, kind} envelope).
 # Extra fields are allowed everywhere: the schema pins the floor a
@@ -137,6 +137,13 @@ SCHEMA = {
     # hit (warm-start state served) | miss (cold start: absent, expired,
     # or shape mismatch) | evict (capacity LRU or TTL expiry)
     "session": {"event"},
+    # graftprof measured attribution (PR 16): one event per profiled
+    # program — measured device seconds vs the roofline-predicted
+    # seconds, per-op-class breakdown, the machine the calibration ran
+    # on, and whether the measured/predicted ratio drifted outside its
+    # pinned prof-budget.json band (the report flags drift=true rows as
+    # anomalies)
+    "profile": {"program", "seconds"},
 }
 
 

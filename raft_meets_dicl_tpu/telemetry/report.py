@@ -256,6 +256,18 @@ def find_anomalies(events, warmup_steps=DEFAULT_WARMUP_STEPS,
                 f"{straces['starved']} steptrace window(s) flagged "
                 "data-starved")
 
+    # calibration drift: a program's measured/predicted ratio left its
+    # pinned prof-budget.json band — the device got slower (or faster)
+    # without the static cost model noticing
+    for e in prof_stats(events)["drifted"]:
+        ratio = e.get("ratio")
+        ratio_s = f"{ratio:.2f}" if ratio is not None else "?"
+        flags.append(
+            f"calibration drift: {e.get('program', '?')[:72]} "
+            f"measured/predicted ratio {ratio_s} outside its pinned "
+            f"band on {e.get('machine', '?')} — profile regression or "
+            f"stale pin (scripts/graftprof.py --update)")
+
     return flags
 
 
@@ -291,6 +303,16 @@ def cost_stats(events):
         for name, n in (e.get("hazards") or {}).items():
             hazards[name] = hazards.get(name, 0) + n
     return {"programs": programs, "hazards": hazards}
+
+
+def prof_stats(events):
+    """Aggregate ``profile`` events (graftprof measured attributions
+    forwarded via ``analysis.profile.emit_events``): one row per
+    profiled program plus the drifted subset the anomaly section
+    flags."""
+    programs = [e for e in events if e["kind"] == "profile"]
+    drifted = [e for e in programs if e.get("drift")]
+    return {"programs": programs, "drifted": drifted}
 
 
 def fault_events(events):
@@ -1031,6 +1053,30 @@ def render(events, errors=(), warmup_steps=DEFAULT_WARMUP_STEPS,
         if cost["hazards"]:
             lines.append("  hazards: " + ", ".join(
                 f"{k}={v}" for k, v in sorted(cost["hazards"].items())))
+
+    prof = prof_stats(events)
+    if prof["programs"]:
+        machines = sorted({e.get("machine", "?")
+                           for e in prof["programs"]})
+        lines.append("")
+        lines.append(f"== profiling ({len(prof['programs'])} programs, "
+                     f"machine {', '.join(machines)}) ==")
+        for e in prof["programs"]:
+            ratio = e.get("ratio")
+            ratio_s = f"{ratio:.2f}" if ratio is not None else "-"
+            classes = ", ".join(
+                f"{k} {v * 1e3:.1f}ms" for k, v in sorted(
+                    (e.get("classes") or {}).items(),
+                    key=lambda kv: -kv[1])[:3])
+            lines.append(
+                f"{e.get('program', '?')[:72]}: measured "
+                f"{e['seconds'] * 1e3:.1f} ms vs predicted "
+                f"{e.get('predicted_seconds', 0) * 1e3:.1f} ms "
+                f"(ratio {ratio_s})"
+                + (f" [{classes}]" if classes else "")
+                + (" [drift]" if e.get("drift") else "")
+                + (" [stale fingerprint]"
+                   if e.get("stale_fingerprint") else ""))
 
     if memory:
         peak_rss = max(m["host_rss_gib"] for m in memory)

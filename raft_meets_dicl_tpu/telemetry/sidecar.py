@@ -20,13 +20,17 @@ The server binds ``127.0.0.1`` (an observability sidecar, not a public
 API) and ``port=0`` picks an ephemeral port (tests).
 """
 
+import glob
 import json
+import os
+import shutil
 import tempfile
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
+from ..utils import env
 from . import metrics as metrics_mod
 
 # every route the sidecar serves; graftlint:sidecar-route checks these
@@ -44,12 +48,36 @@ class ProfileBusy(RuntimeError):
     pass
 
 
-def capture_profile(lock, seconds, max_seconds=MAX_PROFILE_S):
+def evict_captures(keep=None, tmp_root=None):
+    """Bounded /profilez retention: drop the oldest ``rmd-profilez-*``
+    capture dirs beyond the last ``keep`` (``RMD_PROFILE_KEEP``). Every
+    capture used to leak its mkdtemp forever; now each capture evicts.
+    Returns the evicted paths."""
+    if keep is None:
+        keep = env.get_int("RMD_PROFILE_KEEP")
+    keep = max(1, int(keep))  # graftlint: disable=host-sync -- plain python int from an env knob, not a device value
+    root = tmp_root or tempfile.gettempdir()
+    dirs = [d for d in glob.glob(os.path.join(root, "rmd-profilez-*"))
+            if os.path.isdir(d)]
+    dirs.sort(key=os.path.getmtime, reverse=True)
+    evicted = []
+    for d in dirs[keep:]:
+        shutil.rmtree(d, ignore_errors=True)
+        evicted.append(d)
+    return evicted
+
+
+def capture_profile(lock, seconds, max_seconds=MAX_PROFILE_S,
+                    registry=None):
     """Capture ``seconds`` of jax profiler trace into a fresh directory.
 
     Single-flight on ``lock``: a second request while one runs raises
     :class:`ProfileBusy` (the handler maps it to a 409), so a scrape
-    loop can't stack captures.
+    loop can't stack captures. Retention is bounded
+    (:func:`evict_captures`), and unless ``RMD_PROFILE_ATTRIBUTION``
+    is off the response carries an inline graftprof attribution summary
+    next to the artifact path (never failing the capture; a ``registry``
+    additionally gets the ``rmd_prof_*`` gauges).
     """
     seconds = min(max(float(str(seconds)), 0.1), float(max_seconds))  # graftlint: disable=host-sync -- query-string scalar, not a device value
     if not lock.acquire(blocking=False):
@@ -61,7 +89,20 @@ def capture_profile(lock, seconds, max_seconds=MAX_PROFILE_S):
         jax.profiler.start_trace(out)
         time.sleep(seconds)
         jax.profiler.stop_trace()
-        return {"dir": out, "seconds": seconds}
+        payload = {"dir": out, "seconds": seconds}
+        evict_captures()
+        if env.get_bool("RMD_PROFILE_ATTRIBUTION"):
+            try:
+                from ..analysis import profile as prof
+
+                summary = prof.attribute_trace(out)
+                payload["attribution"] = summary
+                if registry is not None:
+                    prof.publish_attribution_metrics(summary, registry)
+            except Exception as e:  # noqa: BLE001 - attribution is advisory; the artifact is the product
+                payload["attribution_error"] = \
+                    f"{type(e).__name__}: {e}"
+        return payload
     finally:
         lock.release()
 
@@ -278,7 +319,8 @@ class TrainObserver:
         return out
 
     def profile(self, seconds):
-        return capture_profile(self._profile_lock, seconds)
+        return capture_profile(self._profile_lock, seconds,
+                               registry=self.registry)
 
 
 def train_observer(ctx, port, sink=None, registry=None, ledger=None):

@@ -81,6 +81,13 @@ KNOBS = dict([
        "trainer observability HTTP port (/metrics, /healthz, /statusz, "
        "/profilez); unset = off, 0 = ephemeral; CLI --metrics-port "
        "wins", "telemetry"),
+    _k("RMD_PROFILE_KEEP", "int", 3,
+       "retained /profilez capture directories: older rmd-profilez-* "
+       "temp dirs are evicted on each capture", "telemetry"),
+    _k("RMD_PROFILE_ATTRIBUTION", "switch", True,
+       "attach a graftprof device-time attribution summary (and "
+       "rmd_prof_* gauges) to /profilez responses and train --profile "
+       "captures; 0 returns the artifact path only", "telemetry"),
     # -- input pipeline ----------------------------------------------------
     _k("RMD_WIRE_FORMAT", "str", None,
        "host-to-device wire format preset (f32 | bf16 | u8); CLI "

@@ -1,22 +1,23 @@
 #!/usr/bin/env python3
 """Per-op profile of the bench training step on the attached accelerator.
 
-Captures a jax.profiler trace of the same step bench.py measures, parses
-the .xplane.pb directly (tensorboard's converter is broken against the
-installed TF), and prints the top XLA ops by self time plus a category
-rollup. Usage:
+Captures a jax.profiler trace of the same step bench.py measures and
+attributes it through graftprof (``analysis.profile``) — the one
+trace-reading code path shared with ``scripts/graftprof.py``,
+``/profilez`` and the telemetry report. Prints the per-module op-class
+attribution plus the top XLA ops by self time. Usage:
 
     python scripts/profile_bench.py [N]   # N = ops to list (default 30)
 """
 
-import glob
 import os
 import sys
 import time
-from collections import defaultdict
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).parent.parent))
+
+from raft_meets_dicl_tpu.analysis import profile as prof  # noqa: E402
 
 
 def capture(trace_dir):
@@ -86,55 +87,18 @@ def capture(trace_dir):
 
 
 def parse(trace_dir, top_n=30):
-    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    """Attribute the capture through graftprof and print the rollup."""
+    summary = prof.attribute_trace(trace_dir, top_ops=top_n)
+    print()
+    print(prof.render_attribution(summary))
 
-    files = glob.glob(f"{trace_dir}/**/*.xplane.pb", recursive=True)
-    assert files, f"no xplane under {trace_dir}"
-    newest = max(files, key=os.path.getmtime)
-    xspace = xplane_pb2.XSpace()
-    xspace.ParseFromString(open(newest, "rb").read())
-
-    ops = defaultdict(float)
-    for plane in xspace.planes:
-        if "TPU" not in plane.name and "/device:" not in plane.name:
-            continue
-        for line in plane.lines:
-            if line.name != "XLA Ops":
-                continue
-            evmeta = plane.event_metadata
-            for event in line.events:
-                name = evmeta[event.metadata_id].name
-                # container events double-count their children
-                if name.startswith(("%while", "jit_", "%tuple")):
-                    continue
-                ops[name] += event.duration_ps / 1e9  # ms
-
-    total = sum(ops.values())
-    print(f"\ndevice op time: {total:.1f} ms over {len(ops)} ops")
-
-    cats = defaultdict(float)
-    for name, ms in ops.items():
-        if "fusion" in name:
-            cats["fusion"] += ms
-        elif "convolution" in name or "conv" in name:
-            cats["convolution"] += ms
-        elif "dot" in name or "einsum" in name:
-            cats["dot"] += ms
-        elif "copy" in name or "transpose" in name or "bitcast" in name:
-            cats["copy/transpose"] += ms
-        elif "reduce" in name:
-            cats["reduce"] += ms
-        elif "all-reduce" in name or "all-gather" in name:
-            cats["collective"] += ms
-        else:
-            cats["other"] += ms
-    print("\ncategory rollup:")
-    for cat, ms in sorted(cats.items(), key=lambda kv: -kv[1]):
-        print(f"  {cat:16s} {ms:8.1f} ms  {100 * ms / total:5.1f}%")
-
+    ops = {}
+    for m in summary["modules"]:
+        for o in m["top_ops"]:
+            ops[o["op"]] = ops.get(o["op"], 0.0) + o["seconds"]
     print(f"\ntop {top_n} ops by total time (3 steps):")
-    for name, ms in sorted(ops.items(), key=lambda kv: -kv[1])[:top_n]:
-        print(f"  {ms:8.2f} ms  {name[:110]}")
+    for name, s in sorted(ops.items(), key=lambda kv: -kv[1])[:top_n]:
+        print(f"  {s * 1e3:8.2f} ms  {name[:110]}")
 
 
 if __name__ == "__main__":
