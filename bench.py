@@ -423,8 +423,12 @@ def _bench_serve():
     AOT artifacts for every (model, bucket, wire) triple into a fresh
     store; (3) a fresh replica against that store — prepared with zero
     compiles (AOT hits only) and serving the full stream the same way.
-    Reports p50/p99 latency, wall + steady-state pairs/s, and shed/error
-    counts. One cumulative JSON line per phase; consumers read the last."""
+    Budget permitting, a fourth phase streams fast-class requests
+    through a ladder'd replica on the quantized matching tier
+    (``BENCH_SERVE_QUANT``, default u8; see ``ops.quant``). Reports
+    p50/p99 latency, wall + steady-state pairs/s, and shed/error counts;
+    every phase row carries a ``quant`` field. One cumulative JSON line
+    per phase; consumers read the last."""
     import shutil
     import tempfile
 
@@ -469,14 +473,14 @@ def _bench_serve():
     wire_name = os.environ.get("BENCH_SERVE_WIRE", "u8")
     wire = mwire.WireFormat.from_config(wire_name)
 
-    def run_phase():
+    def run_phase(quant=None, ladder=None, classes=None):
         # a fresh replica each time: new model spec, new session — the
         # only thing phases may share is the AOT store on disk
         tele = telemetry.get()
         spec = models.load(model_cfg)
         session = serve.ServeSession(
             spec, minput.ShapeBuckets(bucket_sizes), wire=wire,
-            batch_size=batch)
+            batch_size=batch, ladder=ladder, quant=quant)
         t0 = time.perf_counter()
         outcomes = session.warm_pool()
         warm_s = time.perf_counter() - t0
@@ -492,16 +496,19 @@ def _bench_serve():
                     "BENCH_SERVE_SLO_MS", "250"))},
                 objective=0.99, window_s=300.0)
         report = serve.loadgen.run_open_loop(
-            sched, shapes, requests=requests, rate_hz=rate)
+            sched, shapes, requests=requests, rate_hz=rate,
+            classes=classes)
         slo_snap = sched.slo.snapshot()
         trace_snap = sched.trace_summary.snapshot()
         sched.stop(drain=True)
         tail = getattr(tele, "events", [])[mark:]
+        labels = ("eval_step", "rung_step") if ladder else ("eval_step",)
         serve_compiles = [e for e in tail if e["kind"] == "compile"
-                          and e.get("label") == "eval_step"]
+                          and e.get("label") in labels]
         compile_s = sum(e["seconds"] for e in serve_compiles)
         steady = max(report["wall_s"] - compile_s, 1e-9)
         return {
+            "quant": session.quant,
             "completed": report["completed"],
             "rejected": report["rejected"],
             "errors": report["errors"],
@@ -593,6 +600,32 @@ def _bench_serve():
     finally:
         programs.disable_aot()
         shutil.rmtree(tmp, ignore_errors=True)
+
+    # phase 4 (budget permitting): the quantized fast class — a fresh
+    # ladder'd replica on the quant matching tier (BENCH_SERVE_QUANT,
+    # default u8; 'off' skips), streaming fast-class requests — the
+    # class the tier exists for. Every phase row carries a ``quant``
+    # field; only this one is non-null.
+    from raft_meets_dicl_tpu.ops import quant as quant_ops
+
+    qmode = quant_ops.normalize_mode(
+        os.environ.get("BENCH_SERVE_QUANT", "u8"))
+    elapsed = time.monotonic() - t_start
+    if qmode is not None:
+        if elapsed * 4 / 3 > budget_s:
+            result["quant_fast_skipped"] = (
+                f"budget ({elapsed:.0f}s elapsed)")
+            print(f"SKIPPED quant-fast phase: budget "
+                  f"({elapsed:.0f}s of {budget_s:.0f}s used)", flush=True)
+        else:
+            programs.reset()
+            evaluation._EVAL_FN_CACHE.clear()
+            result["quant_fast"] = run_phase(
+                quant=qmode,
+                ladder=serve.LadderSpec(
+                    rungs=(iters, 2 * iters, 3 * iters)),
+                classes=["fast"])
+        _emit(result)
     return result
 
 
@@ -618,8 +651,17 @@ def _bench_ladder():
     ladder is built for. ``adaptive.vs_full`` reports the latency ratio
     and EPE regression against the monolithic full budget — the
     acceptance frontier. One cumulative JSON line per family; consumers
-    read the last."""
+    read the last.
+
+    ``BENCH_LADDER_QUANT`` (default ``u8,i8``) appends quantized base
+    rungs to each family's frontier — the fast class's serving point on
+    the u8/i8 matching tier (``ops.quant``) — with the masked-metric EPE
+    delta against the full-precision base rung, p50/p99 latency, and the
+    correlation-volume bytes per step at each width. Every frontier row
+    carries a ``quant`` field (``null`` = full precision)."""
     from raft_meets_dicl_tpu import evaluation, models
+    from raft_meets_dicl_tpu.metrics import functional as mfunc
+    from raft_meets_dicl_tpu.ops import quant as quant_ops
 
     cpu = jax.default_backend() == "cpu"
     rungs = tuple(int(r) for r in
@@ -670,6 +712,15 @@ def _bench_ladder():
         d = np.asarray(flow, np.float32) - gt
         return float(np.mean(np.sqrt(np.sum(d * d, axis=-1))))
 
+    def volume_bytes(levels, bytes_per_elem):
+        # all-pairs pyramid at 1/8 feature resolution: level l is
+        # (B, h8, w8, h8/2^l, w8/2^l); for raft_fs this is the upper
+        # bound covering the materialized (non-windowed) suffix
+        h8, w8 = h // 8, w // 8
+        elems = sum(batch * h8 * w8 * (h8 >> l) * (w8 >> l)
+                    for l in range(levels))
+        return elems * bytes_per_elem
+
     result = {"metric": "ladder-frontier", "rungs": list(rungs),
               "shape": f"{batch}x{h}x{w}", "families": {}}
     for name, model_cfg in families:
@@ -714,9 +765,53 @@ def _bench_ladder():
                 if k == rungs[0]:
                     base_deltas.append(float(np.max(np.asarray(st["delta"]))))
             fam["frontier"].append({
-                "iterations": k,
+                "iterations": k, "quant": None,
                 "epe": round(sum(errs) / len(errs), 4),
                 "mean_ms": round(1e3 * sum(times) / len(times), 3)})
+
+        # quantized matching tier: the base rung — the fast class's
+        # serving point — re-registered per mode with u8/i8 volumes
+        # dequantized in-register by the lookup. EPE via the masked
+        # metric (all-valid synthetic mask: the same number the
+        # acceptance gate reads); p50/p99 because the tier exists for
+        # latency-critical classes. The dicl families have no quant
+        # path, so they report full-precision rows only.
+        qmodes = [quant_ops.normalize_mode(m) for m in
+                  os.environ.get("BENCH_LADDER_QUANT", "u8,i8").split(",")
+                  if m.strip()]
+        if not model_cfg["type"].startswith("raft/"):
+            qmodes = []
+        params = model_cfg.get("parameters", {})
+        full_itemsize = 2 if params.get("mixed-precision") else 4
+        levels = params.get("corr-levels", 4)
+        base_epe = fam["frontier"][0]["epe"]
+        for mode in [m for m in qmodes if m is not None]:
+            qstep = evaluation.make_rung_fn(model, rungs[0],
+                                            model_id=spec.id, quant=mode)
+            flow, _ = qstep(variables, *batches[0][:2])
+            jax.block_until_ready(flow)
+            valid = jnp.ones((batch, h, w), bool)
+            times, errs = [], []
+            for i1, i2, gt in batches:
+                t0 = time.perf_counter()
+                flow, _ = qstep(variables, i1, i2)
+                jax.block_until_ready(flow)
+                times.append(time.perf_counter() - t0)
+                errs.append(float(np.mean(np.asarray(
+                    mfunc.end_point_error(flow, jnp.asarray(gt),
+                                          valid)["mean"]))))
+            ms = [1e3 * t for t in times]
+            q_epe = sum(errs) / len(errs)
+            fam["frontier"].append({
+                "iterations": rungs[0], "quant": mode,
+                "epe": round(q_epe, 4),
+                "epe_delta_vs_full_precision": round(q_epe - base_epe, 4),
+                "mean_ms": round(sum(ms) / len(ms), 3),
+                "p50_ms": round(float(np.percentile(ms, 50)), 3),
+                "p99_ms": round(float(np.percentile(ms, 99)), 3),
+                "volume_bytes_per_step": volume_bytes(levels, 1),
+                "volume_bytes_full_precision": volume_bytes(
+                    levels, full_itemsize)})
 
         # adaptive: threshold at an upper quantile of the base-rung
         # deltas (see docstring — emulates the converged-model regime
@@ -749,6 +844,7 @@ def _bench_ladder():
         adaptive_ms = 1e3 * sum(times) / len(times)
         adaptive_epe = sum(errs) / len(errs)
         fam["adaptive"] = {
+            "quant": None,
             "threshold": round(threshold, 4),
             "epe": round(adaptive_epe, 4),
             "mean_ms": round(adaptive_ms, 3),

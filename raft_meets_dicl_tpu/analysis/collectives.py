@@ -55,7 +55,14 @@ _DTYPE_BYTES = {
     "s64": 8, "u64": 8, "s32": 4, "u32": 4,
     "s16": 2, "u16": 2, "s8": 1, "u8": 1, "pred": 1,
     "c64": 8, "c128": 16,
+    # sub-f32 widths (compiled-HLO spellings): quantized-tier volumes
+    # and f8 recipes must not fall through to the 4-byte unknown default
+    "f8e4m3": 1, "f8e3m4": 1, "f8e4m3fn": 1, "f8e5m2": 1,
+    "f8e4m3fnuz": 1, "f8e5m2fnuz": 1, "f8e4m3b11fnuz": 1, "f8e8m0fnu": 1,
 }
+
+# sub-byte element widths in bits; byte counts round up per shape
+_DTYPE_BITS = {"s4": 4, "u4": 4, "s2": 2, "u2": 2}
 
 REDUCE_OPS = ("all-reduce", "reduce-scatter")
 RESHARD_OPS = ("all-to-all", "collective-permute")
@@ -80,6 +87,8 @@ def _shape_bytes(dtype, dims):
     for d in dims.split(","):
         if d.strip():
             n *= int(d)  # graftlint: disable=host-sync -- parses an HLO shape string, not a device value
+    if dtype in _DTYPE_BITS:
+        return (n * _DTYPE_BITS[dtype] + 7) // 8
     return n * _DTYPE_BYTES.get(dtype, 4)
 
 

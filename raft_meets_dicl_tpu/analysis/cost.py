@@ -39,7 +39,7 @@ import re
 from dataclasses import dataclass, field
 from pathlib import Path
 
-from .hlo import _DTYPE_BYTES
+from .hlo import _DTYPE_BITS, _DTYPE_BYTES
 from .lint import Finding
 
 # the MXU register tile: operands stream as (sublane=8, lane=128) tiles
@@ -91,6 +91,17 @@ def _parse_tensor(m):
 
 
 def _tensor_nbytes(dims, dtype):
+    """Bytes of one ``tensor<dims x dtype>``.
+
+    Sub-f32 element widths count at their true size — the u8/i8 volumes
+    of the quantized matching tier, f8 formats, packed sub-byte ints
+    (rounded up per tensor) — never at the 4-byte fallback, which is
+    reserved for genuinely unknown dtypes. Charging a quantized operand
+    4 B would erase exactly the HBM-traffic saving the quant tier is
+    pinned to demonstrate.
+    """
+    if dtype in _DTYPE_BITS:
+        return (_prod(dims) * _DTYPE_BITS[dtype] + 7) // 8
     return _prod(dims) * _DTYPE_BYTES.get(dtype, 4)
 
 
@@ -514,8 +525,9 @@ class CostReport:
 def build_entries(include_mesh2d=True, shape=(48, 64)):
     """The audited program set: the flagship tiny-shape train/eval pair,
     the (4, 2)-mesh ZeRO SPMD variant (8 virtual devices), every
-    iteration-ladder rung, and the video warm-start variant — exactly
-    the programs ``hlo-budget.json`` pins."""
+    iteration-ladder rung, the video warm-start variant, and the
+    quantized matching-tier variants (u8/i8 base rung + u8 warm) —
+    exactly the programs ``hlo-budget.json`` pins."""
     import jax
 
     from . import hlo
@@ -526,6 +538,7 @@ def build_entries(include_mesh2d=True, shape=(48, 64)):
                                                mesh2d=True)
     entries += hlo.build_ladder_programs()
     entries += hlo.build_warm_programs()
+    entries += hlo.build_quant_programs()
     return entries
 
 

@@ -49,9 +49,17 @@ _DTYPE_BYTES = {
     "i64": 8, "ui64": 8, "i32": 4, "ui32": 4,
     "i16": 2, "ui16": 2, "i8": 1, "ui8": 1, "i1": 1,
     "c64": 8, "c128": 16,
+    # sub-f32 widths the quantized matching tier (and any f8 recipe)
+    # streams: counting these at the 4-byte unknown-dtype fallback would
+    # erase exactly the HBM saving the tier exists for
+    "f8e4m3": 1, "f8e3m4": 1, "f8e4m3fn": 1, "f8e5m2": 1,
+    "f8e4m3fnuz": 1, "f8e5m2fnuz": 1, "f8e4m3b11fnuz": 1, "f8e8m0fnu": 1,
 }
 
-_TENSOR_RE = re.compile(r"tensor<([0-9x]*)x?([a-z]+[0-9]*)>")
+# sub-byte element widths in bits; byte counts round up per tensor
+_DTYPE_BITS = {"i4": 4, "ui4": 4, "i2": 2, "ui2": 2}
+
+_TENSOR_RE = re.compile(r"tensor<([0-9x]*)x?([a-z][a-z0-9]*)>")
 _CONST_RE = re.compile(
     r"stablehlo\.constant[^:\n]*:\s*tensor<([0-9x]+)x([a-z]+[0-9]*)>")
 
@@ -74,6 +82,8 @@ def _tensor_bytes(dims, dtype):
     for d in dims.split("x"):
         if d:
             n *= int(d)  # graftlint: disable=host-sync -- parses an HLO dims string, not a device value
+    if dtype in _DTYPE_BITS:
+        return (n * _DTYPE_BITS[dtype] + 7) // 8
     return n * _DTYPE_BYTES.get(dtype, 4)
 
 
@@ -389,6 +399,67 @@ def build_warm_programs(rungs=(2, 4, 6), shape=(48, 64), batch=1,
     kwargs = {"expect_bf16": mixed_precision, "n_devices": 1}
     entries = []
     warm = evaluation.make_warm_fn(model, lad.rungs[0], model_id=spec.id)
+    entries.append((warm, (variables, img1, img2, state["flow"]),
+                    dict(kwargs)))
+    return entries
+
+
+def build_quant_programs(rungs=(2, 4, 6), shape=(48, 64), batch=1,
+                         mixed_precision=True):
+    """Register the quantized matching-tier program variants of the
+    ladder-audit model and return ``[(program, args, audit_kwargs)]``
+    for auditing.
+
+    The quant contract the audit pins: the u8 and i8 base rungs plus the
+    u8 warm variant are each exactly one registered program, keyed only
+    by the added ``quant`` flag (plain ladder/warm keys and their pinned
+    budgets untouched); each lowers fingerprint-stably; the bf16 policy
+    survives (the dequantized lookup runs bf16, not f32); and — the
+    tier's reason to exist — the sub-f32 volume bytes show up in the
+    pinned HBM traffic, which is what the integer-width byte accounting
+    in ``cost._tensor_nbytes`` makes honest.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from .. import evaluation, models
+    from ..serve.ladder import LadderSpec
+
+    cfg = {
+        "name": "ladder audit", "id": "ladder-audit",
+        "model": {"type": "raft/baseline",
+                  "parameters": {"corr-levels": 2, "corr-radius": 2,
+                                 "corr-channels": 32,
+                                 "context-channels": 16,
+                                 "recurrent-channels": 16,
+                                 "mixed-precision": mixed_precision}},
+        "loss": {"type": "raft/sequence"},
+        "input": {"padding": {"type": "modulo", "mode": "zeros",
+                              "size": [8, 8]}},
+    }
+    spec = models.load(cfg)
+    model = spec.model
+    h, w = shape
+    rng = np.random.RandomState(0)
+    img1 = jnp.asarray(rng.rand(batch, h, w, 3).astype(np.float32))
+    img2 = jnp.asarray(rng.rand(batch, h, w, 3).astype(np.float32))
+    variables = model.init(jax.random.PRNGKey(0), img1, img2, iterations=1)
+
+    lad = LadderSpec(rungs=rungs)
+    kwargs = {"expect_bf16": mixed_precision, "n_devices": 1}
+    entries = []
+    for mode in ("u8", "i8"):
+        prog = evaluation.make_rung_fn(model, lad.rungs[0], model_id=spec.id,
+                                       quant=mode)
+        entries.append((prog, (variables, img1, img2), dict(kwargs)))
+    # the warm variant serves video warm frames on the quant tier; its
+    # example carry is the quant base rung's coarse flow
+    base = evaluation.make_rung_fn(model, lad.rungs[0], model_id=spec.id,
+                                   quant="u8")
+    _, state = base(variables, img1, img2)
+    warm = evaluation.make_warm_fn(model, lad.rungs[0], model_id=spec.id,
+                                   quant="u8")
     entries.append((warm, (variables, img1, img2, state["flow"]),
                     dict(kwargs)))
     return entries

@@ -131,9 +131,15 @@ def serve(args):
         logging.info("video sessions enabled: warm-start programs + "
                      "sticky per-client carry cache")
 
+    quant = _pick(getattr(args, "quant", None), cfg, "quant",
+                  env.get_str("RMD_QUANT"))
+    if quant:
+        logging.info(f"quantized matching tier: {quant} (fast class + "
+                     "video warm frames)")
+
     session = serving.ServeSession(
         spec, buckets, wire=wire, checkpoint=checkpoint,
-        batch_size=batch_size, ladder=ladder, video=video)
+        batch_size=batch_size, ladder=ladder, video=video, quant=quant)
 
     outcomes = session.warm_pool()
     for o in outcomes:

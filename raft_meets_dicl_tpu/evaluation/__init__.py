@@ -270,7 +270,8 @@ def make_eval_fn(model, model_args=None, mesh=None, wire=None,
 
 
 def make_rung_fn(model, iterations, cont=False, mesh=None, wire=None,
-                 variables_sharding=None, model_id=None, model_args=None):
+                 variables_sharding=None, model_id=None, model_args=None,
+                 quant=None):
     """Registered ladder-rung program: a fixed-``iterations`` inference
     step that returns the continuation carry alongside the final flow.
 
@@ -288,19 +289,34 @@ def make_rung_fn(model, iterations, cont=False, mesh=None, wire=None,
     (kind ``rung_step``), so rungs dedupe process-wide, AOT-export, and
     prefetch like any other program; ``serve --prebuild`` exports the
     whole ladder this way.
+
+    ``quant`` selects the quantized matching tier (``'u8'``/``'i8'``,
+    see ``ops.quant``): the rung runs with a quantized correlation
+    volume pyramid, registered as its own ``quant=...`` ProgramKey flag
+    variant of the same kind. The flag — like ``warm`` — is only
+    present on quant programs, so existing rung keys, AOT artifacts,
+    and budget pins are untouched; ``quant=None`` is byte-identical to
+    the pre-quant builder. The clip ratio (``RMD_QUANT_CLIP``) is read
+    at build time and keyed only when non-default.
     """
     from .. import compile as programs
+    from ..ops import quant as quant_ops
     from ..parallel import partition
+    from ..utils import env
 
     iterations = int(iterations)
     cont = bool(cont)
+    quant = quant_ops.normalize_mode(quant)
+    quant_clip = (float(env.get_float("RMD_QUANT_CLIP"))
+                  if quant is not None else 1.0)
     model_args = dict(model_args or {})
     for reserved in ("iterations", "flow_init", "hidden_init",
-                     "return_state"):
+                     "return_state", "quant", "quant_clip"):
         model_args.pop(reserved, None)
 
     base = _cache_key(model, model_args, mesh, wire, variables_sharding)
-    key = None if base is None else ("rung", iterations, cont) + base
+    key = (None if base is None
+           else ("rung", iterations, cont, quant, quant_clip) + base)
     if key is not None and key in _EVAL_FN_CACHE:
         return _EVAL_FN_CACHE[key]
 
@@ -322,12 +338,17 @@ def make_rung_fn(model, iterations, cont=False, mesh=None, wire=None,
                     else tuple(d.id for d in mesh.devices.flat))
         wire_key = None if wire is None else (
             wire.images, wire.flow, wire.pack_valid, wire.clip, wire.range)
+        qflags = {}
+        if quant is not None:
+            qflags["quant"] = quant
+            if quant_clip != 1.0:
+                qflags["quant_clip"] = quant_clip
         pkey = programs.ProgramKey(
             kind="rung_step",
             model=model_id or programs.unstable(model),
             flags=programs.flag_items(
                 args=args_key, iterations=iterations, cont=cont,
-                mesh=mesh_key, wire=wire_key))
+                mesh=mesh_key, wire=wire_key, **qflags))
         existing = programs.registry().get(pkey)
         if existing is not None:
             return _cache(existing)
@@ -340,6 +361,9 @@ def make_rung_fn(model, iterations, cont=False, mesh=None, wire=None,
     forward_args = dict(model_args)
     forward_args["iterations"] = iterations
     forward_args["return_state"] = True
+    if quant is not None:
+        forward_args["quant"] = quant
+        forward_args["quant_clip"] = quant_clip
 
     def _forward(variables, img1, img2, flow, hidden):
         if gather:
@@ -379,12 +403,14 @@ def make_rung_fn(model, iterations, cont=False, mesh=None, wire=None,
     step._refs = (model,)
     step.iterations = iterations
     step.cont = cont
+    step.quant = quant
 
     return _cache(step)
 
 
 def make_warm_fn(model, iterations, mesh=None, wire=None,
-                 variables_sharding=None, model_id=None, model_args=None):
+                 variables_sharding=None, model_id=None, model_args=None,
+                 quant=None):
     """Registered temporal warm-start program for video sequences:
     ``(variables, img1, img2, flow) -> (final_flow, state)`` where
     ``flow`` is the *previous frame's* coarse flow (the ``state["flow"]``
@@ -406,19 +432,31 @@ def make_warm_fn(model, iterations, mesh=None, wire=None,
     warm programs, so existing rung keys/AOT artifacts/budget pins are
     untouched); warm programs dedupe, AOT-export, and prefetch like any
     rung, and ``serve --prebuild`` covers them via ``warm_pool()``.
+
+    ``quant`` routes the warm program onto the quantized matching tier
+    exactly like :func:`make_rung_fn` — video warm frames are the other
+    latency-critical consumer of the quant tier, and with ``flow=0`` a
+    quant warm program stays bit-exact versus the quant base rung (the
+    parity argument above is mode-independent).
     """
     from .. import compile as programs
+    from ..ops import quant as quant_ops
     from ..ops import warp
     from ..parallel import partition
+    from ..utils import env
 
     iterations = int(iterations)
+    quant = quant_ops.normalize_mode(quant)
+    quant_clip = (float(env.get_float("RMD_QUANT_CLIP"))
+                  if quant is not None else 1.0)
     model_args = dict(model_args or {})
     for reserved in ("iterations", "flow_init", "hidden_init",
-                     "return_state"):
+                     "return_state", "quant", "quant_clip"):
         model_args.pop(reserved, None)
 
     base = _cache_key(model, model_args, mesh, wire, variables_sharding)
-    key = None if base is None else ("rung", iterations, "warm") + base
+    key = (None if base is None
+           else ("rung", iterations, "warm", quant, quant_clip) + base)
     if key is not None and key in _EVAL_FN_CACHE:
         return _EVAL_FN_CACHE[key]
 
@@ -437,12 +475,17 @@ def make_warm_fn(model, iterations, mesh=None, wire=None,
                     else tuple(d.id for d in mesh.devices.flat))
         wire_key = None if wire is None else (
             wire.images, wire.flow, wire.pack_valid, wire.clip, wire.range)
+        qflags = {}
+        if quant is not None:
+            qflags["quant"] = quant
+            if quant_clip != 1.0:
+                qflags["quant_clip"] = quant_clip
         pkey = programs.ProgramKey(
             kind="rung_step",
             model=model_id or programs.unstable(model),
             flags=programs.flag_items(
                 args=args_key, iterations=iterations, cont=False,
-                warm=True, mesh=mesh_key, wire=wire_key))
+                warm=True, mesh=mesh_key, wire=wire_key, **qflags))
         existing = programs.registry().get(pkey)
         if existing is not None:
             return _cache(existing)
@@ -455,6 +498,9 @@ def make_warm_fn(model, iterations, mesh=None, wire=None,
     forward_args = dict(model_args)
     forward_args["iterations"] = iterations
     forward_args["return_state"] = True
+    if quant is not None:
+        forward_args["quant"] = quant
+        forward_args["quant_clip"] = quant_clip
 
     def step(variables, img1, img2, flow):
         if gather:
@@ -484,6 +530,7 @@ def make_warm_fn(model, iterations, mesh=None, wire=None,
     step.iterations = iterations
     step.cont = False
     step.warm = True
+    step.quant = quant
 
     return _cache(step)
 

@@ -259,6 +259,14 @@ def main():
                             "client then submits sticky frame streams "
                             "(also: the config's 'video' key) "
                             "[default: off]")
+    serve.add_argument("--quant", nargs="?", const="u8",
+                       choices=["u8", "i8", "off"], metavar="MODE",
+                       help="quantized matching tier for the fast ladder "
+                            "class and video warm frames: correlation "
+                            "volumes stored u8/i8 and dequantized "
+                            "in-register by the lookup ('u8' when given "
+                            "bare; also: RMD_QUANT, the config's 'quant' "
+                            "key) [default: off]")
     serve.add_argument("--prebuild", action="store_true",
                        help="compile + AOT-export every (model, bucket, "
                             "wire) program triple — with --ladder, every "

@@ -38,6 +38,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from ...ops import quant as quant_ops
 from ...ops.corr import (
     correlation_pyramid_direct,
     lookup_pyramid_levels,
@@ -414,7 +415,8 @@ class RaftModule(nn.Module):
     @nn.compact
     def __call__(self, img1, img2, train=False, frozen_bn=False, iterations=12,
                  flow_init=None, hidden_init=None, upnet=True, corr_flow=False,
-                 corr_grad_stop=False, mask_costs=(), return_state=False):
+                 corr_grad_stop=False, mask_costs=(), return_state=False,
+                 quant=None, quant_clip=1.0):
         hdim = self.recurrent_channels
         cdim = self.context_channels
         reg_args = self.corr_reg_args or {}
@@ -447,8 +449,23 @@ class RaftModule(nn.Module):
         # the O(H²W²) volume cannot exist at all). Each pyramid level is a
         # direct einsum against pooled f2 (bf16 under the policy: halves
         # volume HBM traffic; lookup einsums still accumulate in f32).
-        pyramid = tuple(correlation_pyramid_direct(
-            fmap1, fmap2, self.corr_levels, dtype=dt))
+        # quantized matching tier (inference-only, ops.quant): u8 stores
+        # the same pyramid affinely mapped per level; i8 additionally runs
+        # the correlation dots themselves in int8. Either way the lookup
+        # einsums dequantize in-register, so the per-iteration HBM stream
+        # is the quantized bytes. quant=None is the bit-exact default.
+        qmode = quant_ops.normalize_mode(quant)
+        if qmode == "i8":
+            pyramid = tuple(quant_ops.correlation_pyramid_int8(
+                fmap1, fmap2, self.corr_levels, clip=quant_clip))
+        elif qmode == "u8":
+            pyramid = tuple(quant_ops.quantize_pyramid(
+                correlation_pyramid_direct(
+                    fmap1, fmap2, self.corr_levels, dtype=dt),
+                qmode, clip=quant_clip))
+        else:
+            pyramid = tuple(correlation_pyramid_direct(
+                fmap1, fmap2, self.corr_levels, dtype=dt))
 
         ctx = cnet(img1, train, frozen_bn)
         h = jnp.tanh(ctx[..., :hdim])

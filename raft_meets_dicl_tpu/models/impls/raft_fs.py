@@ -17,6 +17,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from ...ops import quant as quant_ops
 from ...ops.corr import correlation_volume, lookup_pyramid_levels
 from ...ops.pallas import windowed_corr_pyramid
 from ...ops.pool import avg_pool2d
@@ -169,7 +170,8 @@ class RaftFsModule(nn.Module):
     @nn.compact
     def __call__(self, img1, img2, train=False, frozen_bn=False,
                  iterations=12, flow_init=None, hidden_init=None, upnet=True,
-                 mask_costs=(), return_state=False):
+                 mask_costs=(), return_state=False, quant=None,
+                 quant_clip=1.0):
         hdim = self.recurrent_channels
         cdim = self.context_channels
         dt = jnp.bfloat16 if self.mixed_precision else None
@@ -217,10 +219,21 @@ class RaftFsModule(nn.Module):
         f2_pyramid = [fmap2]
         for _ in range(1, self.corr_levels):
             f2_pyramid.append(avg_pool2d(f2_pyramid[-1], 2))
-        pyramid = f2_pyramid[:n_windowed] + [
+        # quantized matching tier (ops.quant): the materialized coarse
+        # suffix is stored at the quantized width and dequantized
+        # in-register by the lookup einsums. The windowed prefix never
+        # materializes a volume, so there is nothing to quantize there —
+        # both modes reduce to storage quantization here (the int8
+        # feature-dot construction is a RaftModule path).
+        qmode = quant_ops.normalize_mode(quant)
+        volumes = [
             correlation_volume(fmap1, f2, dtype=dt, normalize=False)
             for f2 in f2_pyramid[n_windowed:]
         ]
+        if qmode is not None:
+            volumes = quant_ops.quantize_pyramid(volumes, qmode,
+                                                 clip=quant_clip)
+        pyramid = f2_pyramid[:n_windowed] + volumes
 
         ctx = cnet(img1, train, frozen_bn)
         h = jnp.tanh(ctx[..., :hdim])

@@ -15,5 +15,6 @@ justifies them (see ``ops.pallas``).
 from .sample import grid_sample, sample_bilinear
 from .pool import avg_pool2d, max_pool2d
 from .corr import all_pairs_correlation, correlation_pyramid, lookup_pyramid, CorrVolume
+from .quant import QuantizedLevel, quantize_level, dequantize_level, quantize_pyramid, correlation_pyramid_int8
 from .upsample import convex_upsample_8x, interpolate_bilinear, upsample_flow_2x
 from .warp import warp_backwards, coordinate_grid

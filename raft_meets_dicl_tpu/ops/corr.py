@@ -20,6 +20,8 @@ positions with channel 0 = x, 1 = y; lookup output channels ordered
 
 import jax.numpy as jnp
 
+from .quant import QuantizedLevel, zero_point
+
 
 def all_pairs_correlation(fmap1, fmap2):
     """(B, H, W, C) x (B, H, W, C) -> (B, H, W, H, W) dot-product volume.
@@ -162,7 +164,28 @@ def _lookup_level(corr, x, y):
     window lookup contracts the volume with two tiny structured
     interpolation matrices. Both contractions ride the MXU and their VJPs
     are transposed einsums (no scatter in the backward pass).
+
+    ``corr`` may be a ``quant.QuantizedLevel`` (the quantized matching
+    tier): the integer values are converted and zero-shifted in bf16 —
+    a convert that fuses into the einsum operand read on TPU, so the
+    HBM stream stays at the quantized width — and the symmetric scale,
+    being a constant factor of the linear contraction, applies once to
+    the small (B, H1, W1, K, K) output instead of the O(H²W²) volume.
     """
+    if isinstance(corr, QuantizedLevel):
+        values, scale = corr
+        h2, w2 = values.shape[-2:]
+        wy = _interp_matrix(y, h2).astype(jnp.bfloat16)
+        wx = _interp_matrix(x, w2).astype(jnp.bfloat16)
+        deq = (values.astype(jnp.bfloat16)
+               - jnp.asarray(zero_point(values), jnp.bfloat16))
+        t = jnp.einsum("bijkh,bijhw->bijkw", wy, deq,
+                       preferred_element_type=jnp.float32)
+        t = t.astype(jnp.bfloat16)
+        out = jnp.einsum("bijkw,bijaw->bijka", t, wx,
+                         preferred_element_type=jnp.float32)
+        return out * scale
+
     h2, w2 = corr.shape[-2:]
     wy = _interp_matrix(y, h2)  # (B, H1, W1, K, H2)
     wx = _interp_matrix(x, w2)  # (B, H1, W1, K, W2)

@@ -1,0 +1,191 @@
+"""Quantized matching tier: u8/int8 correlation volumes (inference only).
+
+The windowed lookup is memory-bound — every GRU iteration streams the
+full volume pyramid from HBM while the contraction itself uses 9/128 of
+the MXU (PERF.md round 5 killed the fused-kernel alternative for
+exactly this reason). The remaining lever is the *byte* side: the
+volume is a similarity score, not a precision-critical activation, so
+the fast latency classes store it quantized and dequantize in-register
+inside the lookup einsums, shrinking the dominant HBM stream 2x versus
+bf16 (4x versus f32).
+
+Two modes, both with per-level per-sample symmetric scales:
+
+- ``u8`` — the pyramid is computed exactly as the full-precision tier
+  computes it (f32-accumulated MXU einsums, cast per the model's
+  precision policy), then each level is affinely mapped onto the u8
+  grid with zero point 128: ``q = round(c / s) + 128``,
+  ``c ≈ (q - 128) * s``. One extra rounding step per level at build
+  time; the per-iteration lookup stream is 1 byte/element.
+- ``i8`` — the correlation itself runs as int8 MXU dots: features are
+  range-equalized per (sample, channel) (``g1 = f1 / a``,
+  ``g2 = f2 * a`` with ``a = sqrt(amax|f1| / amax|f2|)`` leaves every
+  dot product invariant), quantized to int8 per sample, contracted with
+  int32 accumulation, dequantized by the product of scales, and the
+  resulting volume is requantized to i8 for storage. Same 1
+  byte/element stream, plus the build-time einsums move 4x fewer
+  operand bytes than f32.
+
+The scale factors out of the (linear) lookup contraction, so dequant
+applies once to the small (B, H, W, K, K) window output instead of the
+O(H²W²) volume; the u8→bf16 convert-and-shift fuses into the einsum
+operand read on TPU, keeping the HBM stream at the quantized width.
+Everything here is plain jnp — XLA lowers it on any backend (the
+CPU/GPU fallback path of the quant tier) and the programs AOT-export
+like any other rung.
+
+Inference-only by design: no custom VJPs, no straight-through
+estimators. Training stays on the full-precision tier.
+"""
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+#: quantized-volume modes accepted by ``normalize_mode``
+MODES = ("u8", "i8")
+
+#: guard against all-zero levels (synthetic inputs, masked costs)
+_EPS = 1e-12
+
+
+def normalize_mode(mode):
+    """Canonicalize a quant-mode spec to ``'u8'``, ``'i8'``, or ``None``.
+
+    Accepts the CLI/env spellings (``'u8'``/``'uint8'``,
+    ``'i8'``/``'int8'``/``'s8'``, and ``'off'``/``'none'``/``'0'``/empty
+    for disabled); ``True`` means the default mode (``'u8'``). Raises
+    ``ValueError`` on anything else so a typo'd ``RMD_QUANT`` fails loud
+    at session build, not silently full-precision.
+    """
+    if mode is None or mode is False:
+        return None
+    if mode is True:
+        return "u8"
+    m = str(mode).strip().lower()
+    if m in ("", "0", "off", "none", "false"):
+        return None
+    if m in ("u8", "uint8"):
+        return "u8"
+    if m in ("i8", "int8", "s8"):
+        return "i8"
+    raise ValueError(
+        f"unknown quantization mode {mode!r}: expected one of "
+        f"{MODES + ('off',)}")
+
+
+class QuantizedLevel(NamedTuple):
+    """One quantized pyramid level: integer values plus dequant scale.
+
+    A NamedTuple of arrays only, so it traverses pytree boundaries
+    (nn.scan broadcast inputs, jit arguments) like the raw volume it
+    replaces. The zero point is implied by the dtype — 128 for uint8,
+    0 for int8 — keeping the pytree free of static leaves.
+    """
+
+    values: jnp.ndarray  # (B, H1, W1, H2, W2) uint8 or int8
+    scale: jnp.ndarray   # (B, 1, 1, 1, 1) float32, symmetric step size
+
+
+def zero_point(values):
+    """The implied zero point of a quantized array: 128 for u8, 0 for i8."""
+    return 128 if values.dtype == jnp.uint8 else 0
+
+
+def _symmetric_scale(x, axes, clip):
+    """Per-sample symmetric step size: ``clip * amax / 127`` over ``axes``."""
+    amax = jnp.max(jnp.abs(x), axis=axes, keepdims=True)
+    return jnp.maximum(amax * clip, _EPS) / 127.0
+
+
+def quantize_level(corr, mode, clip=1.0):
+    """Quantize one (B, H1, W1, H2, W2) volume level to a QuantizedLevel.
+
+    Symmetric per-sample scale (axis 0 stays independent — serve batches
+    mix unrelated requests, one outlier sample must not crush another's
+    resolution). ``clip`` shrinks the mapped range to a fraction of the
+    observed abs-max, trading outlier saturation for finer steps on the
+    bulk (``RMD_QUANT_CLIP``); values beyond the range saturate.
+    """
+    mode = normalize_mode(mode)
+    if mode is None:
+        raise ValueError("quantize_level requires an explicit mode")
+    corr32 = corr.astype(jnp.float32)
+    scale = _symmetric_scale(corr32, (1, 2, 3, 4), clip)
+    q = jnp.round(corr32 / scale)
+    if mode == "u8":
+        values = jnp.clip(q + 128.0, 0.0, 255.0).astype(jnp.uint8)
+    else:
+        values = jnp.clip(q, -127.0, 127.0).astype(jnp.int8)
+    return QuantizedLevel(values=values, scale=scale)
+
+
+def dequantize_level(level, dtype=jnp.float32):
+    """Reconstruct the float volume: ``(q - zero_point) * scale``."""
+    deq = level.values.astype(jnp.float32) - zero_point(level.values)
+    return (deq * level.scale).astype(dtype)
+
+
+def quantize_pyramid(pyramid, mode, clip=1.0):
+    """Quantize every level of a volume pyramid (the ``u8`` tier path)."""
+    return [quantize_level(corr, mode, clip=clip) for corr in pyramid]
+
+
+def _quantize_features(fmap, clip):
+    """Per-sample int8 feature quantization for the i8 correlation dots.
+
+    Returns ``(q, s)`` with q int8 (B, H, W, C) and s (B, 1, 1, 1) so
+    ``q1 · q2 * s1 * s2`` reconstructs the float dot up to rounding.
+    """
+    f = fmap.astype(jnp.float32)
+    scale = _symmetric_scale(f, (1, 2, 3), clip)
+    q = jnp.clip(jnp.round(f / scale), -127.0, 127.0).astype(jnp.int8)
+    return q, scale
+
+
+def correlation_pyramid_int8(fmap1, fmap2, num_levels=4, normalize=True,
+                             clip=1.0):
+    """All-pairs pyramid where the correlation itself runs as int8 dots.
+
+    Drop-in quantized twin of ``corr.correlation_pyramid_direct``: same
+    per-level structure (one einsum against a progressively pooled
+    frame-2 map), but the operands are per-sample int8 features and the
+    contraction accumulates in int32 — on TPU that's the MXU's native
+    int8 path at 4x less operand traffic than f32. Channel ranges of the
+    two maps are equalized first (``g1 = f1 / a``, ``g2 = f2 * a``;
+    every product ``g1·g2 = f1·f2`` is invariant) so one hot channel on
+    either side doesn't consume the shared sample-level range. Each
+    dequantized level is then requantized to i8 storage
+    (``quantize_level``) for the lookup stream.
+
+    Pooling runs on the float equalized maps (quantize-then-pool would
+    compound rounding), so each level's int8 dot sees a freshly
+    quantized pooled map.
+    """
+    from .corr import _pool2x_spatial
+
+    f1 = fmap1.astype(jnp.float32)
+    g2 = fmap2.astype(jnp.float32)
+    c = f1.shape[-1]
+
+    # per-(sample, channel) range equalizer over the spatial axes
+    m1 = jnp.max(jnp.abs(f1), axis=(1, 2), keepdims=True)
+    m2 = jnp.max(jnp.abs(g2), axis=(1, 2), keepdims=True)
+    a = jnp.sqrt(jnp.maximum(m1, _EPS) / jnp.maximum(m2, _EPS))
+    g1 = f1 / a
+    g2 = g2 * a
+
+    norm = (1.0 / jnp.sqrt(jnp.asarray(c, jnp.float32))
+            if normalize else jnp.asarray(1.0, jnp.float32))
+    q1, s1 = _quantize_features(g1, clip)
+
+    pyramid = []
+    for lvl in range(num_levels):
+        q2, s2 = _quantize_features(g2, clip)
+        acc = jnp.einsum("bijc,bklc->bijkl", q1, q2,
+                         preferred_element_type=jnp.int32)
+        corr = acc.astype(jnp.float32) * (s1 * s2 * norm)[..., None]
+        pyramid.append(quantize_level(corr, "i8", clip=clip))
+        if lvl + 1 < num_levels:
+            g2 = _pool2x_spatial(g2)
+    return pyramid

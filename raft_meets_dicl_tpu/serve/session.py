@@ -33,7 +33,8 @@ class ServeSession:
     """
 
     def __init__(self, spec, buckets, wire=None, checkpoint=None,
-                 batch_size=4, mesh=None, ladder=None, video=False):
+                 batch_size=4, mesh=None, ladder=None, video=False,
+                 quant=None):
         buckets = ShapeBuckets.from_config(buckets) \
             if not isinstance(buckets, ShapeBuckets) else buckets
         if buckets is None or not buckets.sizes:
@@ -67,12 +68,24 @@ class ServeSession:
         # (or AOT-loaded) every bucket's program — before that a request
         # would pay a cold compile the operator thinks was prepaid
         self.ready = False
+        # quantized matching tier (RMD_QUANT / --quant, ops.quant): the
+        # latency-critical programs — the fast class's base rung and the
+        # video warm frames — run with quantized correlation volumes.
+        # Continuation increments and the monolithic full budget stay
+        # full-precision, so the balanced class escalates from the quant
+        # base onto full-precision rungs exactly as the ladder threshold
+        # already decides, and quality is untouched.
+        from ..ops import quant as quant_ops
+
+        self.quant = quant_ops.normalize_mode(quant)
         self._rung_fns = {}
         if ladder is not None:
             for its, cont in ladder.programs():
+                q = (self.quant
+                     if (not cont and its == ladder.rungs[0]) else None)
                 self._rung_fns[(its, cont)] = evaluation.make_rung_fn(
                     self.model, its, cont=cont, mesh=mesh, wire=wire,
-                    model_id=spec.id)
+                    model_id=spec.id, quant=q)
 
         # video sessions (PR 15): one warm-start program per bucket set —
         # the fast rung re-entered from the previous frame's carry (the
@@ -90,12 +103,12 @@ class ServeSession:
                 else env.get_int("RMD_VIDEO_WARM_ITERATIONS"))
             self._warm_fn = evaluation.make_warm_fn(
                 self.model, self.warm_iterations, mesh=mesh, wire=wire,
-                model_id=spec.id)
+                model_id=spec.id, quant=self.quant)
             if (self.warm_iterations, False) not in self._rung_fns:
                 self._rung_fns[(self.warm_iterations, False)] = \
                     evaluation.make_rung_fn(
                         self.model, self.warm_iterations, mesh=mesh,
-                        wire=wire, model_id=spec.id)
+                        wire=wire, model_id=spec.id, quant=self.quant)
 
     @classmethod
     def from_config(cls, model_cfg, buckets, **kwargs):
@@ -269,6 +282,8 @@ class ServeSession:
             }
             if rung is not None:
                 outcome["rung"] = rung
+            if getattr(step, "quant", None):
+                outcome["quant"] = step.quant
             outcomes.append(outcome)
             telemetry.get().emit("serve", event="warmup", **outcome)
 

@@ -183,6 +183,14 @@ KNOBS = dict([
     _k("RMD_LADDER_THRESHOLD", "float", 0.1,
        "flow-delta norm (coarse-grid px) below which the balanced class "
        "stops escalating rungs", "serve"),
+    _k("RMD_QUANT", "str", None,
+       "quantized matching tier for the fast serve class and video warm "
+       "frames ('u8' or 'i8'; unset/off = full precision); CLI --quant "
+       "/ config wins", "serve"),
+    _k("RMD_QUANT_CLIP", "float", 1.0,
+       "fraction of the per-level abs-max mapped onto the quantized "
+       "range (values beyond it saturate); <1 trades outlier clipping "
+       "for finer steps on the bulk", "serve"),
     _k("RMD_METRICS_PORT", "int", 0,
        "serve observability HTTP port (/metrics, /healthz, /statusz, "
        "/profilez); 0 = off; CLI --metrics-port wins", "serve"),

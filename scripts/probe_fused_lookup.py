@@ -14,6 +14,10 @@ bench config three ways:
   B. Pallas, per-position serial dots from VMEM-resident rows
   C. Pallas, both lookup stages fused per position (t = wy @ corr,
      out = t @ wx^T) so the intermediate never leaves VMEM
+  D. XLA einsum over the u8-quantized volume, dequantized in-register
+     as the stage-1 operand (the ops/corr.py quantized-tier branch) —
+     same contraction, 1/4 (f32) or 1/2 (bf16) of the volume bytes
+     streamed from HBM
 
 If B/C do not beat A, the contraction is MXU-shape-bound — the 9-row
 operand uses 9/128 of the systolic array regardless of who schedules
@@ -50,6 +54,19 @@ def _xla_lookup(wy, corr, wx):
     t = t.astype(wy.dtype)
     return jnp.einsum("bijkw,bijaw->bijka", t, wx,
                       preferred_element_type=jnp.float32)
+
+
+def _xla_lookup_u8(wy, qvals, scale, wx):
+    # the ops/corr.py quantized-tier branch: u8 rows stream from HBM and
+    # dequantize in-register as the stage-1 einsum operand (zero point
+    # 128); the per-sample scale lands once on the (K, K) output
+    deq = qvals.astype(wy.dtype) - jnp.asarray(128, wy.dtype)
+    t = jnp.einsum("bijkh,bijhw->bijkw", wy, deq,
+                   preferred_element_type=jnp.float32)
+    t = t.astype(wy.dtype)
+    out = jnp.einsum("bijkw,bijaw->bijka", t, wx,
+                     preferred_element_type=jnp.float32)
+    return out * scale
 
 
 def _stage1_kernel(wy_ref, corr_ref, out_ref):
@@ -184,6 +201,24 @@ def main():
     except Exception as e:  # pragma: no cover - probe reporting
         print(f"C  Pallas fused both stages: FAILED ({type(e).__name__}: "
               f"{str(e)[:140]})")
+
+    # D answers a byte-bound question, not a FLOP-bound one: the lookup
+    # reads the whole volume row set every iteration, so streaming u8
+    # moves 1/4 (f32) or 1/2 (bf16) of arm A's bytes. Quantization is
+    # a one-time cost at pyramid build, so it stays outside the timer.
+    from raft_meets_dicl_tpu.ops import quant as rmq
+
+    level = rmq.quantize_level(jnp.asarray(corr, jnp.float32), "u8")
+    scale = level.scale.astype(jnp.float32)
+    t_d, out_d = _time(jax.jit(_xla_lookup_u8), wy, level.values, scale,
+                       wx, steps=args.steps)
+    err_d = float(jnp.max(jnp.abs(out_d - out_a)))
+    ratio = jnp.dtype(dt).itemsize  # u8 volume is 1 B/element
+    print(f"D  XLA u8 volume, in-reg dequant:    {t_d * 1e3:8.3f} ms"
+          f"  ({flops_full / t_d / 1e12:.2f} TFLOP/s)")
+    print(f"   max |D - A| = {err_d:.3e}  (step "
+          f"{float(jnp.max(level.scale)):.3e}); volume bytes 1/{ratio} "
+          f"of arm A")
 
 
 if __name__ == "__main__":

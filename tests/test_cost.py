@@ -284,10 +284,13 @@ def test_budget_gate_green_on_committed_pins(audited):
     _, rep = audited
     assert rep.ok, cost.render_reports(rep)
     assert rep.stale == [], f"stale budget pins: {rep.stale}"
-    n = 8 if jax.device_count() >= 8 else 6
+    n = 11 if jax.device_count() >= 8 else 9
     assert len(rep.reports) == n
     # the video warm-start variant is part of the audited set
     assert any("'warm', 'True'" in r["key"] for r in rep.reports)
+    # ... as are the quantized matching-tier variants (u8/i8 base rung
+    # plus the u8 warm frame)
+    assert sum("'quant'" in r["key"] for r in rep.reports) == 3
     # every audited program is pinned, and pinned exactly
     pinned = set(json.loads(
         (REPO / cost.BUDGET_NAME).read_text())["entries"])
