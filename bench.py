@@ -33,6 +33,9 @@ def _emit(result):
     bench is attributable without re-running under a profiler."""
     from raft_meets_dicl_tpu.telemetry import goodput
 
+    # every BENCH_* row names its augmentation arm ("off" unless a bench
+    # sets one), so result consumers can split host/device/synth series
+    result.setdefault("augment", "off")
     ledger = goodput.get()
     if ledger.enabled:
         snap = ledger.snapshot()
@@ -260,6 +263,92 @@ def _bench_input():
             "wire_mb_per_step": round(wire_mb, 3),
         }
         _emit(result)
+
+    # augmentation arms (PR 19): the same raw source decoded three ways —
+    # "host" augments inside the decode path (seeded-Generator numpy
+    # transforms), "device" ships raw batches and runs the jitted
+    # DeviceAugment pipeline on the accelerator, "synth" renders
+    # exact-flow pairs on device and never decodes at all. samples/s is
+    # end-to-end; data_wait_share is the fraction of wall time spent
+    # outside device compute (what a training step would stall on).
+    from raft_meets_dicl_tpu.data import augment as haug
+    from raft_meets_dicl_tpu.data import synth as dsynth
+    from raft_meets_dicl_tpu.data.device_augment import DeviceAugment
+
+    def _collate_ms(adapter):
+        samples = [adapter[i] for i in range(min(batch, n))]
+        t0 = time.perf_counter()
+        reps = 5
+        for _ in range(reps):
+            minput.collate(samples)
+        return (time.perf_counter() - t0) / reps * 1e3
+
+    host_src = haug.Augment(
+        [haug.ColorJitter(0.2, 0.4, 0.4, 0.4, 0.1),
+         haug.Flip([0.5, 0.1]),
+         haug.NoiseNormal([0.0, 0.02]),
+         haug.OcclusionForward(0.5, [1, 3], [10, 10], [30, 30])],
+        Synth(n, height, width), sync=True)
+    adapter = spec.apply(host_src, normalize=True).jax()
+    loader = adapter.loader(batch_size=batch, shuffle=False, procs=procs)
+    t0 = time.perf_counter()
+    decoded = 0
+    for b in loader:
+        decoded += b[0].shape[0]
+    dt = time.perf_counter() - t0
+    result["augment"] = "host"
+    result["host-augment"] = {
+        "samples_per_sec": round(decoded / dt, 2),
+        "collate_ms": round(_collate_ms(adapter), 2),
+        "data_wait_share": 1.0,
+    }
+    _emit(result)
+
+    dev = DeviceAugment(occlusion_size=(10, 30))
+    dev_fn = jax.jit(lambda ids, a, b, f, v: dev.apply(
+        dev.batch_keys(ids, 0), a, b, f, v))
+    adapter = spec.apply(Synth(n, height, width), normalize=True).jax()
+    loader = adapter.loader(batch_size=batch, shuffle=False, procs=procs)
+    warm = [jnp.asarray(a) for a in next(iter(loader))[:4]]
+    jax.block_until_ready(dev_fn(
+        jnp.arange(warm[0].shape[0], dtype=jnp.uint32), *warm))
+    t0 = time.perf_counter()
+    decoded, device_s = 0, 0.0
+    for i, b in enumerate(loader):
+        arrs = [jnp.asarray(a) for a in b[:4]]
+        ids = jnp.arange(i * batch, i * batch + arrs[0].shape[0],
+                         dtype=jnp.uint32)
+        t1 = time.perf_counter()
+        out = dev_fn(ids, *arrs)
+        jax.block_until_ready(out)
+        device_s += time.perf_counter() - t1
+        decoded += arrs[0].shape[0]
+    dt = time.perf_counter() - t0
+    result["augment"] = "device"
+    result["device-augment"] = {
+        "samples_per_sec": round(decoded / dt, 2),
+        "collate_ms": round(_collate_ms(adapter), 2),
+        "device_ms_per_batch": round(
+            device_s / max(1, decoded // batch) * 1e3, 2),
+        "data_wait_share": round(max(0.0, 1.0 - device_s / dt), 4),
+    }
+    _emit(result)
+
+    render = jax.jit(lambda k: dsynth.render_pair(k, (height, width)))
+    k0 = jax.random.PRNGKey(0)
+    jax.block_until_ready(render(k0))
+    t0 = time.perf_counter()
+    for i in range(n):
+        out = render(jax.random.fold_in(k0, i))
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    result["augment"] = "synth"
+    result["synth-source"] = {
+        "samples_per_sec": round(n / dt, 2),
+        "collate_ms": 0.0,
+        "data_wait_share": 0.0,
+    }
+    _emit(result)
     return result
 
 
@@ -922,6 +1011,19 @@ def _bench_video():
         gt[..., 0] = dx
         gt[..., 1] = dy
         sequences.append((frames, [gt] * (n_frames - 1)))
+
+    # plus one layered-scene sequence from the synthetic scenario
+    # generator (PR 19): coherent per-layer affine motion with exact
+    # per-pair dense flow — the warm-start signal a roll-drift sequence
+    # can't probe (flow varies across the frame and over time)
+    from raft_meets_dicl_tpu.data import synth as dsynth
+
+    imgs, flows, _ = dsynth.render_sequence(
+        jax.random.PRNGKey(19), (h, w), frames=n_frames, motion=3.0)
+    imgs = np.repeat(np.asarray(imgs)[:, None], batch, axis=1)
+    flows = np.repeat(np.asarray(flows)[:, None], batch, axis=1)
+    sequences.append(([imgs[t] for t in range(n_frames)],
+                      [flows[t] for t in range(n_frames - 1)]))
 
     variables = model.init(jax.random.PRNGKey(0),
                            jnp.asarray(sequences[0][0][0]),

@@ -525,9 +525,10 @@ class CostReport:
 def build_entries(include_mesh2d=True, shape=(48, 64)):
     """The audited program set: the flagship tiny-shape train/eval pair,
     the (4, 2)-mesh ZeRO SPMD variant (8 virtual devices), every
-    iteration-ladder rung, the video warm-start variant, and the
-    quantized matching-tier variants (u8/i8 base rung + u8 warm) —
-    exactly the programs ``hlo-budget.json`` pins."""
+    iteration-ladder rung, the video warm-start variant, the quantized
+    matching-tier variants (u8/i8 base rung + u8 warm), and the
+    on-device data-engine pair (augmented train step + synth renderer)
+    — exactly the programs ``hlo-budget.json`` pins."""
     import jax
 
     from . import hlo
@@ -539,6 +540,7 @@ def build_entries(include_mesh2d=True, shape=(48, 64)):
     entries += hlo.build_ladder_programs()
     entries += hlo.build_warm_programs()
     entries += hlo.build_quant_programs()
+    entries += hlo.build_aug_programs()
     return entries
 
 

@@ -465,6 +465,78 @@ def build_quant_programs(rungs=(2, 4, 6), shape=(48, 64), batch=1,
     return entries
 
 
+def build_aug_programs(shape=(48, 64), batch=2):
+    """Register the on-device data-engine program variants and return
+    ``[(program, args, audit_kwargs)]`` for auditing.
+
+    The PR-19 contract the audit pins: the augmented train step is
+    exactly one registered program keyed only by the added ``augment``
+    flag (the plain audit train key and its pinned budget untouched —
+    ``augment=None`` returns the identical Program), and the jitted
+    synthetic scenario generator registers as its own ``synth_pair``
+    program, so its render cost is budgeted like any other device
+    program instead of hiding in the input pipeline.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from .. import compile as programs, models, parallel
+    from ..data import synth
+    from ..data.device_augment import DeviceAugment
+
+    flagship = {
+        "name": "RAFT baseline", "id": "raft-baseline",
+        "model": {"type": "raft/baseline", "parameters": {}},
+        "loss": {"type": "raft/sequence"},
+        "input": {"padding": {"type": "modulo", "mode": "zeros",
+                              "size": [8, 8]}},
+    }
+    spec = models.load(flagship)
+    model, loss = spec.model, spec.loss
+    h, w = shape
+    rng = np.random.RandomState(0)
+    img1 = jnp.asarray(rng.rand(batch, h, w, 3).astype(np.float32))
+    img2 = jnp.asarray(rng.rand(batch, h, w, 3).astype(np.float32))
+    flow = jnp.asarray(rng.randn(batch, h, w, 2).astype(np.float32))
+    valid = jnp.asarray(np.ones((batch, h, w), bool))
+    sample_ids = jnp.asarray(np.arange(batch, dtype=np.uint32))
+
+    model_args = {"iterations": 2}
+    variables = model.init(jax.random.PRNGKey(0), img1[:1], img2[:1],
+                           **model_args)
+    tx = optax.chain(optax.clip_by_global_norm(1.0), optax.adamw(1e-4))
+    state = parallel.TrainState.create(variables, tx)
+
+    # same fixed configuration as cfg/env/device-aug.yaml, so the pinned
+    # program is the one a real --device-aug run compiles
+    augment = DeviceAugment()
+    key = programs.ProgramKey(
+        kind="train_step", model="raft-baseline",
+        flags=programs.flag_items(shape=(batch, h, w), audit=1,
+                                  mesh2d=False))
+    prog = parallel.make_train_step(
+        model, loss, tx, model_args=model_args, donate=False, key=key,
+        augment=augment)
+
+    entries = [(prog, (state, img1, img2, flow, valid, sample_ids,
+                       jnp.int32(0)),
+                {"n_devices": 1})]
+
+    # the synthetic generator: exact flow supervision rendered on device
+    synth_key = programs.ProgramKey(
+        kind="synth_pair", model="synth",
+        flags=programs.flag_items(shape=(h, w), audit=1))
+    synth_prog = programs.register_step(
+        "synth_pair",
+        jax.jit(lambda k: synth.render_pair(k, (h, w))),
+        key=synth_key)
+    entries.append((synth_prog, (jax.random.PRNGKey(0),),
+                    {"n_devices": 1}))
+    return entries
+
+
 def audit_registry(entries=None, **build_kwargs):
     """Audit every (program, args, kwargs) entry; defaults to the
     flagship tiny-shape build. Returns ``(reports, findings)``."""

@@ -43,12 +43,13 @@ class Environment:
             nonfinite=cfg.get("nonfinite"),
             parallel=cfg.get("parallel", {}),
             compile=cfg.get("compile", {}),
+            augment=cfg.get("augment"),
             debug_nans=cfg.get("jax", {}).get("debug-nans", False),
             deterministic=cfg.get("jax", {}).get("deterministic", False),
         )
 
     def __init__(self, loader_args={}, wire=None, eval={}, nonfinite=None,
-                 parallel={}, compile={}, debug_nans=False,
+                 parallel={}, compile={}, augment=None, debug_nans=False,
                  deterministic=False):
         self.loader_args = dict(loader_args)
         # wire config: preset name ('f32'/'bf16'/'u8') or mapping with
@@ -72,6 +73,11 @@ class Environment:
         # disables the AOT program store, {aot: DIR} relocates it.
         # --compile-cache / RMD_COMPILE_CACHE / RMD_AOT* override it.
         self.compile = dict(compile or {})
+        # augment section: on-device augmentation parameters
+        # (data.device_augment.DeviceAugment.from_config); its presence
+        # with enabled: true turns the device path on, --device-aug and
+        # RMD_DEVICE_AUG force it on with these (or default) parameters.
+        self.augment = augment
         self.debug_nans = debug_nans
         self.deterministic = deterministic
 
@@ -83,6 +89,7 @@ class Environment:
             "nonfinite": self.nonfinite,
             "parallel": self.parallel,
             "compile": self.compile,
+            "augment": self.augment,
             "jax": {
                 "debug-nans": self.debug_nans,
                 "deterministic": self.deterministic,
@@ -448,12 +455,27 @@ def _train(args):
     if nonfinite.policy != "raise":
         logging.info(f"non-finite step policy: {nonfinite.get_config()}")
 
+    # on-device augmentation: --device-aug / RMD_DEVICE_AUG / the env
+    # config's 'augment' section (enabled: true). The section's remaining
+    # keys parameterize data.device_augment.DeviceAugment; off keeps the
+    # historical host-side augmentation and registered-program identities.
+    from ..data.device_augment import DeviceAugment
+
+    aug_cfg = dict(env.augment or {})
+    aug_on = bool(getattr(args, "device_aug", None)
+                  or utils.env.get_bool("RMD_DEVICE_AUG")
+                  or aug_cfg.pop("enabled", False))
+    aug_cfg.pop("enabled", None)
+    augment = DeviceAugment.from_config(aug_cfg) if aug_on else None
+    if augment is not None:
+        logging.info(f"on-device augmentation: {augment.describe()}")
+
     log = utils.logging.Logger()
     tctx = TrainingContext(
         log, path_out, strat, model_id, model_spec, model_adapter, loss, input,
         inspector, chkptm, mesh=mesh, step_limit=args.steps,
         loader_args=loader_args, wire=wire, eval_buckets=eval_buckets,
-        nonfinite=nonfinite, accumulate=accumulate,
+        nonfinite=nonfinite, accumulate=accumulate, augment=augment,
     )
 
     if args.checkpoint:

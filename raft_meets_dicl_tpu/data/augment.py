@@ -12,13 +12,48 @@ jitter is implemented natively in numpy (HSV-based, torchvision-style
 semantics: factor ranges, random op order, symmetric-vs-asymmetric draw)
 rather than delegating to torchvision, which keeps the input pipeline free of
 torch.
+
+Random draws go through an explicit ``numpy.random.Generator`` threaded into
+``process`` — ``Augment`` derives it per sample from
+``(seed, epoch, sample_id)``, so augmentation is reproducible and
+race-free across decode-pool workers (the module-level ``np.random`` state is
+per-process and draw-order dependent). ``seed: legacy`` in the config keeps
+the historical unseeded behavior.
 """
+
+import hashlib
 
 import cv2
 import numpy as np
 import scipy.ndimage as ndimage
 
 from .collection import Collection
+
+
+class _LegacyRandom:
+    """Generator-API shim over the module-level ``np.random`` state.
+
+    Keeps ``seed: legacy`` configs (and direct ``aug(*sample)`` calls without
+    an explicit Generator) byte-compatible with the historical draw sequence.
+    """
+
+    def random(self):
+        return np.random.rand()
+
+    def uniform(self, low=0.0, high=1.0, size=None):
+        return np.random.uniform(low, high, size)
+
+    def normal(self, loc=0.0, scale=1.0, size=None):
+        return np.random.normal(loc, scale, size)
+
+    def permutation(self, x):
+        return np.random.permutation(x)
+
+    def integers(self, low, high=None, size=None):
+        return np.random.randint(low, high, size)
+
+
+_LEGACY = _LegacyRandom()
 
 _CV2_MODES = {
     "nearest": cv2.INTER_NEAREST,
@@ -34,6 +69,12 @@ class Augment(Collection):
     ``sync=True`` applies each transform once across the whole pre-batched
     sample (one random draw per batch); ``sync=False`` splits the batch and
     augments each sample independently.
+
+    ``seed`` keys a per-sample ``np.random.Generator`` from
+    ``(seed, epoch, sample_id)`` — the same sample in the same epoch draws the
+    same augmentation regardless of iteration order, worker assignment, or
+    resume point (the device path keys identically). ``seed="legacy"``
+    restores the historical module-level ``np.random`` draws.
     """
 
     type = "augment"
@@ -45,13 +86,16 @@ class Augment(Collection):
         cls._typecheck(cfg)
 
         augs = [build_augmentation(a) for a in (cfg["augmentations"] or [])]
-        return cls(augs, data_config.load(path, cfg["source"]), cfg.get("sync", True))
+        return cls(augs, data_config.load(path, cfg["source"]), cfg.get("sync", True),
+                   cfg.get("seed", 0))
 
-    def __init__(self, augmentations, source, sync=True):
+    def __init__(self, augmentations, source, sync=True, seed=0):
         super().__init__()
         self.augmentations = augmentations
         self.source = source
         self.sync = sync
+        self.seed = seed
+        self.epoch = 0
 
     def get_config(self):
         return {
@@ -59,25 +103,43 @@ class Augment(Collection):
             "augmentations": [a.get_config() for a in self.augmentations],
             "source": self.source.get_config(),
             "sync": self.sync,
+            "seed": self.seed,
         }
 
-    def _apply(self, sample):
+    def set_epoch(self, epoch):
+        self.epoch = int(epoch)
+        super().set_epoch(epoch)
+
+    def _rng_for(self, meta):
+        if self.seed == "legacy":
+            return _LEGACY
+        sid = hashlib.blake2s(
+            f"{meta.dataset_id}/{meta.sample_id}".encode(), digest_size=8
+        ).digest()
+        return np.random.default_rng(
+            (int(self.seed), self.epoch, int.from_bytes(sid, "little"))
+        )
+
+    def _apply(self, sample, rng):
         for aug in self.augmentations:
-            sample = aug(*sample)
+            sample = aug(*sample, rng=rng)
         return sample
 
     def __getitem__(self, index):
         img1, img2, flow, valid, meta = self.source[index]
 
         if self.sync:
-            img1, img2, flow, valid, meta = self._apply((img1, img2, flow, valid, meta))
+            img1, img2, flow, valid, meta = self._apply(
+                (img1, img2, flow, valid, meta), self._rng_for(meta[0])
+            )
         else:
             parts = []
             for i in range(img1.shape[0]):
                 f = flow[i : i + 1] if flow is not None else None
                 v = valid[i : i + 1] if valid is not None else None
                 parts.append(
-                    self._apply((img1[i : i + 1], img2[i : i + 1], f, v, [meta[i]]))
+                    self._apply((img1[i : i + 1], img2[i : i + 1], f, v, [meta[i]]),
+                                self._rng_for(meta[i]))
                 )
 
             img1 = np.concatenate([p[0] for p in parts], axis=0)
@@ -115,11 +177,12 @@ class Augmentation:
     def get_config(self):
         raise NotImplementedError
 
-    def process(self, img1, img2, flow, valid, meta):
+    def process(self, img1, img2, flow, valid, meta, rng=_LEGACY):
         raise NotImplementedError
 
-    def __call__(self, img1, img2, flow, valid, meta):
-        return self.process(img1, img2, flow, valid, meta)
+    def __call__(self, img1, img2, flow, valid, meta, rng=None):
+        return self.process(img1, img2, flow, valid, meta,
+                            rng if rng is not None else _LEGACY)
 
 
 # -- color jitter -----------------------------------------------------------
@@ -203,7 +266,7 @@ class ColorJitter(Augmentation):
             return float(value[0]), float(value[1])
         return max(lower_bound, center - float(value)), center + float(value)
 
-    def _draw(self):
+    def _draw(self, rng):
         b = self._factor_range(self.brightness)
         c = self._factor_range(self.contrast)
         s = self._factor_range(self.saturation)
@@ -214,22 +277,22 @@ class ColorJitter(Augmentation):
         ) if self.hue else None
 
         return (
-            np.random.permutation(4),
-            np.random.uniform(*b) if b else None,
-            np.random.uniform(*c) if c else None,
-            np.random.uniform(*s) if s else None,
-            np.random.uniform(*h) if h else None,
+            rng.permutation(4),
+            rng.uniform(*b) if b else None,
+            rng.uniform(*c) if c else None,
+            rng.uniform(*s) if s else None,
+            rng.uniform(*h) if h else None,
         )
 
-    def _transform(self, img):
-        return _jitter_once(img, self._draw())
+    def _transform(self, img, rng):
+        return _jitter_once(img, self._draw(rng))
 
-    def process(self, img1, img2, flow, valid, meta):
-        if np.random.rand() < self.prob_asymmetric:
-            img1 = self._transform(img1)
-            img2 = self._transform(img2)
+    def process(self, img1, img2, flow, valid, meta, rng=_LEGACY):
+        if rng.random() < self.prob_asymmetric:
+            img1 = self._transform(img1, rng)
+            img2 = self._transform(img2, rng)
         else:
-            stack = _jitter_once(np.stack((img1, img2)), self._draw())
+            stack = _jitter_once(np.stack((img1, img2)), self._draw(rng))
             img1, img2 = stack[0], stack[1]
 
         return img1, img2, flow, valid, meta
@@ -244,17 +307,17 @@ class ColorJitter8bit(ColorJitter):
     def _quantize(img):
         return np.round(np.clip(img, 0.0, 1.0) * 255.0).astype(np.uint8)
 
-    def _transform(self, img):
+    def _transform(self, img, rng):
         img = self._quantize(img).astype(np.float32) / 255.0
-        img = _jitter_once(img, self._draw())
+        img = _jitter_once(img, self._draw(rng))
         return self._quantize(img).astype(np.float32) / 255.0
 
-    def process(self, img1, img2, flow, valid, meta):
-        if np.random.rand() < self.prob_asymmetric:
-            img1 = self._transform(img1)
-            img2 = self._transform(img2)
+    def process(self, img1, img2, flow, valid, meta, rng=_LEGACY):
+        if rng.random() < self.prob_asymmetric:
+            img1 = self._transform(img1, rng)
+            img2 = self._transform(img2, rng)
         else:
-            stack = self._transform(np.stack((img1, img2)))
+            stack = self._transform(np.stack((img1, img2)), rng)
             img1, img2 = stack[0], stack[1]
 
         return img1, img2, flow, valid, meta
@@ -296,14 +359,14 @@ class Crop(Augmentation):
     def get_config(self):
         return {"type": self.type, "size": self.size}
 
-    def process(self, img1, img2, flow, valid, meta):
+    def process(self, img1, img2, flow, valid, meta, rng=_LEGACY):
         assert img1.shape[:3] == img2.shape[:3]
 
         w, h = self.size
         mx = img1.shape[2] - w
         my = img1.shape[1] - h
-        x0 = np.random.randint(0, mx) if mx > 0 else 0
-        y0 = np.random.randint(0, my) if my > 0 else 0
+        x0 = rng.integers(0, mx) if mx > 0 else 0
+        y0 = rng.integers(0, my) if my > 0 else 0
 
         return _crop(img1, img2, flow, valid, meta, x0, y0, w, h)
 
@@ -311,7 +374,7 @@ class Crop(Augmentation):
 class CropCenter(Crop):
     type = "crop-center"
 
-    def process(self, img1, img2, flow, valid, meta):
+    def process(self, img1, img2, flow, valid, meta, rng=_LEGACY):
         assert img1.shape[:3] == img2.shape[:3]
 
         w, h = self.size
@@ -341,14 +404,14 @@ class Flip(Augmentation):
     def get_config(self):
         return {"type": self.type, "probability": self.probability}
 
-    def process(self, img1, img2, flow, valid, meta):
-        if np.random.rand() < self.probability[0]:  # horizontal
+    def process(self, img1, img2, flow, valid, meta, rng=_LEGACY):
+        if rng.random() < self.probability[0]:  # horizontal
             img1, img2 = img1[:, :, ::-1], img2[:, :, ::-1]
             if flow is not None:
                 flow = flow[:, :, ::-1] * (-1.0, 1.0)
                 valid = valid[:, :, ::-1]
 
-        if np.random.rand() < self.probability[1]:  # vertical
+        if rng.random() < self.probability[1]:  # vertical
             img1, img2 = img1[:, ::-1], img2[:, ::-1]
             if flow is not None:
                 flow = flow[:, ::-1] * (1.0, -1.0)
@@ -378,14 +441,14 @@ class NoiseNormal(Augmentation):
     def get_config(self):
         return {"type": self.type, "stddev": self.stddev}
 
-    def process(self, img1, img2, flow, valid, meta):
+    def process(self, img1, img2, flow, valid, meta, rng=_LEGACY):
         if self.stddev[0] < self.stddev[1]:
-            stddev = np.random.uniform(self.stddev[0], self.stddev[1])
+            stddev = rng.uniform(self.stddev[0], self.stddev[1])
         else:
             stddev = self.stddev[0]
 
-        img1 = np.clip(img1 + np.random.normal(0.0, stddev, img1.shape), 0.0, 1.0)
-        img2 = np.clip(img2 + np.random.normal(0.0, stddev, img2.shape), 0.0, 1.0)
+        img1 = np.clip(img1 + rng.normal(0.0, stddev, img1.shape), 0.0, 1.0)
+        img2 = np.clip(img2 + rng.normal(0.0, stddev, img2.shape), 0.0, 1.0)
 
         return img1, img2, flow, valid, meta
 
@@ -436,20 +499,20 @@ class _Occlusion(Augmentation):
             "skew-correction": self.skew_correction,
         }
 
-    def _patch(self, img):
-        if np.random.rand() >= self.probability:
+    def _patch(self, img, rng):
+        if rng.random() >= self.probability:
             return img
 
         img = img.copy()
         h, w = img.shape[1:3]
-        num = self.num[0] if self.num[0] == self.num[1] else np.random.randint(*self.num)
+        num = self.num[0] if self.num[0] == self.num[1] else rng.integers(*self.num)
 
         for _ in range(num):
-            dx, dy = np.random.randint(self.min_size, self.max_size)
+            dx, dy = rng.integers(self.min_size, self.max_size)
             if self.skew_correction:
-                y0, x0 = np.random.randint((-dy + 1, -dx + 1), (h, w))
+                y0, x0 = rng.integers((-dy + 1, -dx + 1), (h, w))
             else:
-                y0, x0 = np.random.randint((0, 0), (h, w))
+                y0, x0 = rng.integers((0, 0), (h, w))
 
             ys, xs = max(0, y0), max(0, x0)
             ye, xe = min(h, y0 + dy), min(w, x0 + dx)
@@ -462,15 +525,15 @@ class _Occlusion(Augmentation):
 class OcclusionForward(_Occlusion):
     type = "occlusion-forward"
 
-    def process(self, img1, img2, flow, valid, meta):
-        return img1, self._patch(img2), flow, valid, meta
+    def process(self, img1, img2, flow, valid, meta, rng=_LEGACY):
+        return img1, self._patch(img2, rng), flow, valid, meta
 
 
 class OcclusionBackward(_Occlusion):
     type = "occlusion-backward"
 
-    def process(self, img1, img2, flow, valid, meta):
-        return self._patch(img1), img2, flow, valid, meta
+    def process(self, img1, img2, flow, valid, meta, rng=_LEGACY):
+        return self._patch(img1, rng), img2, flow, valid, meta
 
 
 class RestrictFlowMagnitude(Augmentation):
@@ -490,7 +553,7 @@ class RestrictFlowMagnitude(Augmentation):
     def get_config(self):
         return {"type": self.type, "maximum": self.maximum}
 
-    def process(self, img1, img2, flow, valid, meta):
+    def process(self, img1, img2, flow, valid, meta, rng=_LEGACY):
         mag = np.linalg.norm(flow, ord=2, axis=-1)
         return img1, img2, flow, valid & (mag < self.maximum), meta
 
@@ -601,19 +664,19 @@ class _ScaleBase(Augmentation):
             cfg["th-valid"] = self.th_valid
         return cfg
 
-    def _draw_factors(self):
+    def _draw_factors(self, rng):
         raise NotImplementedError
 
-    def _new_size(self, input_size):
-        sx, sy = self._draw_factors()
+    def _new_size(self, input_size, rng):
+        sx, sy = self._draw_factors(rng)
         old = np.array(input_size)[::-1]  # (w, h)
         new = np.clip(np.ceil(old * [sx, sy]).astype(np.int32), self.min_size, None)
         return new, new / old
 
-    def process(self, img1, img2, flow, valid, meta):
+    def process(self, img1, img2, flow, valid, meta, rng=_LEGACY):
         assert img1.shape[:3] == img2.shape[:3]
 
-        size, scale = self._new_size(img1.shape[1:3])
+        size, scale = self._new_size(img1.shape[1:3], rng)
         mode = _CV2_MODES[self.mode]
 
         img1 = _resize_batch(img1, size, mode)
@@ -636,11 +699,11 @@ class Scale(_ScaleBase):
 
     type = "scale"
 
-    def _draw_factors(self):
-        scale = np.random.uniform(self.min_scale, self.max_scale)
+    def _draw_factors(self, rng):
+        scale = rng.uniform(self.min_scale, self.max_scale)
         stretch = 0.0
-        if np.random.rand() < self.prob_stretch:
-            stretch = np.random.uniform(-self.max_stretch, self.max_stretch)
+        if rng.random() < self.prob_stretch:
+            stretch = rng.uniform(-self.max_stretch, self.max_stretch)
         return scale * 2 ** (stretch / 2), scale * 2 ** -(stretch / 2)
 
 
@@ -654,12 +717,12 @@ class ScaleExp(_ScaleBase):
 
     type = "scale-exp"
 
-    def _draw_factors(self):
-        scale = 2.0 ** np.random.uniform(self.min_scale, self.max_scale)
+    def _draw_factors(self, rng):
+        scale = 2.0 ** rng.uniform(self.min_scale, self.max_scale)
         sx = sy = scale
-        if np.random.rand() < self.prob_stretch:
-            sx *= 2.0 ** np.random.uniform(-self.max_stretch, self.max_stretch)
-            sy *= 2.0 ** np.random.uniform(-self.max_stretch, self.max_stretch)
+        if rng.random() < self.prob_stretch:
+            sx *= 2.0 ** rng.uniform(-self.max_stretch, self.max_stretch)
+            sy *= 2.0 ** rng.uniform(-self.max_stretch, self.max_stretch)
         return sx, sy
 
 
@@ -695,13 +758,13 @@ class Translate(Augmentation):
     def get_config(self):
         return {"type": self.type, "min-size": self.min_size, "delta": self.delta}
 
-    def process(self, img1, img2, flow, valid, meta):
+    def process(self, img1, img2, flow, valid, meta, rng=_LEGACY):
         assert img1.shape[:3] == img2.shape[:3]
 
         _, h, w, _ = img1.shape
         dx = np.clip(w - self.min_size[0], 0, self.delta[0])
         dy = np.clip(h - self.min_size[1], 0, self.delta[1])
-        tx, ty = np.random.randint((-dx, -dy), (dx + 1, dy + 1))
+        tx, ty = rng.integers((-dx, -dy), (dx + 1, dy + 1))
 
         img1 = img1[:, max(0, ty) : min(h, h + ty), max(0, tx) : min(w, w + tx)]
         img2 = img2[:, max(0, -ty) : min(h, h - ty), max(0, -tx) : min(w, w - tx)]
@@ -756,11 +819,11 @@ class Rotate(Augmentation):
             "th-valid": self.th_valid,
         }
 
-    def process(self, img1, img2, flow, valid, meta):
+    def process(self, img1, img2, flow, valid, meta, rng=_LEGACY):
         assert img1.shape == img2.shape
 
-        angle = np.random.uniform(self.range[0], self.range[1])
-        diff = np.random.uniform(-self.deviation, self.deviation)
+        angle = rng.uniform(self.range[0], self.range[1])
+        diff = rng.uniform(-self.deviation, self.deviation)
         angle1 = angle - diff / 2
         angle2 = angle + diff / 2
 

@@ -38,6 +38,22 @@ class Collection:
     def description(self):
         raise NotImplementedError
 
+    def set_epoch(self, epoch):
+        """Advance epoch-dependent state (seeded augmentation draws).
+
+        Recurses through the wrapper graph via the conventional
+        ``source``/``sources`` attributes; the trainer calls this before
+        iterating each epoch, *before* decode workers fork, so the value
+        is captured by every worker.
+        """
+        for attr in ("source", "sources"):
+            val = getattr(self, attr, None)
+            if val is None:
+                continue
+            for child in val if isinstance(val, (list, tuple)) else (val,):
+                if isinstance(child, Collection):
+                    child.set_epoch(epoch)
+
 
 @dataclass
 class SampleArgs:

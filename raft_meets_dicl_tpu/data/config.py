@@ -13,12 +13,13 @@ from .augment import Augment
 from .combinators import Cache, Concat, Repeat, Subset
 from .dataset import Dataset
 from .fw_bw import ForwardsBackwardsBatch, ForwardsBackwardsEstimate
+from .synth import Synth
 
 _TYPES = {
     cls.type: cls
     for cls in (
         Dataset, Augment, Cache, Concat, Repeat, Subset,
-        ForwardsBackwardsBatch, ForwardsBackwardsEstimate,
+        ForwardsBackwardsBatch, ForwardsBackwardsEstimate, Synth,
     )
 }
 

@@ -1,0 +1,419 @@
+"""On-device data augmentation: the host transform set as jitted XLA ops.
+
+JAX port of the host augmentation pipeline (``data/augment.py``), compiled
+*into* the registered train step via
+``parallel.train.make_train_step(augment=...)`` so the accelerator — not
+the input pipeline — pays for augmentation. At pod scale host decode +
+augment is the next ``data_wait`` bottleneck (the goodput ledger's
+``data_starved`` class measures it directly); moving the transforms into
+the step removes them from the host critical path entirely.
+
+Two design rules govern everything here:
+
+- **One fused warp.** All geometric transforms — zoom/stretch (scale),
+  rotation, window translation (crop jitter), flips, and the frame-2
+  differential shift (translate) — compose into a single inverse-affine
+  resampling of ``(img1, img2, flow, valid)``. Output pixel ``p = (y, x)``
+  samples input coordinate ``q = A·p + b`` (``A`` the inverse linear map);
+  frame 2 samples at ``q - Δ``, and the flow field remaps exactly as
+
+      flow'(p) = M · (flow(q) + Δ),   M = A⁻¹
+
+  which reproduces the host semantics transform by transform: flips negate
+  the matching flow component, scaling multiplies vectors by the scale
+  factor, the differential shift adds to the flow (``Translate``), and
+  rotation rotates the vectors into the new frame. The output shape
+  equals the input shape, so batches stay on the existing bucket grid and
+  the registered program count is unchanged.
+
+- **Stateless keying.** Every random draw derives from
+  ``fold_in(fold_in(PRNGKey(seed), epoch), sample_id)`` — deterministic,
+  order-independent, and resumable: re-running an epoch (or resuming
+  mid-epoch from a checkpoint) reproduces bit-identical augmented batches,
+  because a sample's key depends only on ``(sample_id, epoch)``, never on
+  step order, host RNG state, or which worker decoded it.
+
+Photometric transforms (color jitter with the asymmetric draw, gaussian
+noise, the eraser occlusion) are elementwise device ops after the warp,
+applied in the model's normalized value range (``bound``). One documented
+deviation from the host: the jitter ops apply in fixed order
+brightness→contrast→saturation→hue instead of a randomly drawn order —
+a per-sample op permutation would need a 24-way ``lax.switch`` for a
+statistically negligible effect.
+"""
+
+import hashlib
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+# ITU-R 601 luma weights, as in the host jitter (augment._rgb_to_gray)
+_LUMA = (0.2989, 0.587, 0.114)
+
+
+def sample_id_array(meta):
+    """Stable uint32 ids for a batch's metadata list.
+
+    Hash of ``dataset_id/sample_id`` — independent of epoch order,
+    shuffling, worker assignment, and resume point, which is what makes
+    the device augmentation stream reproducible.
+    """
+    ids = np.empty(len(meta), dtype=np.uint32)
+    for i, m in enumerate(meta):
+        blob = f"{m.dataset_id}/{m.sample_id}".encode()
+        ids[i] = int.from_bytes(
+            hashlib.blake2s(blob, digest_size=4).digest(), "little")
+    return ids
+
+
+def _bilinear(img, qy, qx):
+    """Bilinear sample at float coords (edge clamp); exact on the grid.
+
+    At integer coordinates every weight is exactly 0.0 or 1.0, so pure
+    crops and flips reproduce the host output bit for bit.
+    """
+    h, w = img.shape[0], img.shape[1]
+    y0 = jnp.floor(qy)
+    x0 = jnp.floor(qx)
+    ty = (qy - y0).astype(jnp.float32)
+    tx = (qx - x0).astype(jnp.float32)
+
+    y0i = jnp.clip(y0.astype(jnp.int32), 0, h - 1)
+    x0i = jnp.clip(x0.astype(jnp.int32), 0, w - 1)
+    y1i = jnp.minimum(y0i + 1, h - 1)
+    x1i = jnp.minimum(x0i + 1, w - 1)
+
+    if img.ndim == 3:
+        ty, tx = ty[..., None], tx[..., None]
+
+    v00 = img[y0i, x0i]
+    v01 = img[y0i, x1i]
+    v10 = img[y1i, x0i]
+    v11 = img[y1i, x1i]
+
+    top = v00 * (1.0 - tx) + v01 * tx
+    bot = v10 * (1.0 - tx) + v11 * tx
+    return top * (1.0 - ty) + bot * ty
+
+
+def warp_affine(img1, img2, flow, valid, mat, offset, delta=(0.0, 0.0),
+                th_valid=0.99, out_shape=None):
+    """Fused inverse-affine warp of one sample.
+
+    ``mat`` (2×2) and ``offset`` (2,) define the *inverse* map in (y, x)
+    coordinates: output pixel ``p`` samples input coordinate
+    ``q = mat @ p + offset``. ``delta`` (y, x) shifts the frame-2
+    sampling to ``q - delta`` (the translate augmentation); the flow
+    remaps as ``M (flow(q) + delta)`` with ``M = inv(mat)``.
+
+    ``valid`` resamples as a soft mask thresholded at ``th_valid`` and is
+    cleared where the frame-1 source coordinate leaves the frame.
+    ``out_shape`` defaults to the input shape (bucket-preserving); parity
+    tests pass a smaller shape to reproduce a host crop exactly.
+    """
+    h, w = img1.shape[0], img1.shape[1]
+    oh, ow = (h, w) if out_shape is None else out_shape
+    mat = jnp.asarray(mat, jnp.float32)
+    offset = jnp.asarray(offset, jnp.float32)
+    delta = jnp.asarray(delta, jnp.float32)
+
+    py, px = jnp.meshgrid(jnp.arange(oh, dtype=jnp.float32),
+                          jnp.arange(ow, dtype=jnp.float32), indexing="ij")
+    q1y = mat[0, 0] * py + mat[0, 1] * px + offset[0]
+    q1x = mat[1, 0] * py + mat[1, 1] * px + offset[1]
+    q2y = q1y - delta[0]
+    q2x = q1x - delta[1]
+
+    out1 = _bilinear(img1, q1y, q1x)
+    out2 = _bilinear(img2, q2y, q2x)
+    f = _bilinear(flow, q1y, q1x)
+    v = _bilinear(valid.astype(jnp.float32)[..., None], q1y, q1x)[..., 0]
+
+    # forward linear map M = inv(mat), closed-form 2x2
+    det = mat[0, 0] * mat[1, 1] - mat[0, 1] * mat[1, 0]
+    m00 = mat[1, 1] / det
+    m01 = -mat[0, 1] / det
+    m10 = -mat[1, 0] / det
+    m11 = mat[0, 0] / det
+
+    fy = f[..., 1] + delta[0]
+    fx = f[..., 0] + delta[1]
+    flow_out = jnp.stack((m10 * fy + m11 * fx,    # x component
+                          m00 * fy + m01 * fx),   # y component
+                         axis=-1)
+
+    inb = (q1y >= 0) & (q1y <= h - 1) & (q1x >= 0) & (q1x <= w - 1)
+    valid_out = inb & (v >= th_valid)
+    return out1, out2, flow_out, valid_out
+
+
+def _shift_hue(x, shift):
+    """Hue rotation by ``shift`` (fraction of a full turn) on [0,1] RGB."""
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    mx = jnp.max(x, axis=-1)
+    mn = jnp.min(x, axis=-1)
+    d = mx - mn
+    safe = jnp.where(d > 0, d, 1.0)
+    hue = jnp.where(mx == r, ((g - b) / safe) % 6.0,
+                    jnp.where(mx == g, (b - r) / safe + 2.0,
+                              (r - g) / safe + 4.0))
+    hue = jnp.where(d > 0, hue / 6.0, 0.0)
+    hue = (hue + shift) % 1.0
+    sat = jnp.where(mx > 0, d / jnp.where(mx > 0, mx, 1.0), 0.0)
+
+    def chan(n):
+        k = (n + hue * 6.0) % 6.0
+        return mx - mx * sat * jnp.clip(jnp.minimum(k, 4.0 - k), 0.0, 1.0)
+
+    return jnp.stack((chan(5.0), chan(3.0), chan(1.0)), axis=-1)
+
+
+def _gray(x):
+    return (x[..., 0] * _LUMA[0] + x[..., 1] * _LUMA[1]
+            + x[..., 2] * _LUMA[2])
+
+
+def _apply_jitter(x, p):
+    """Brightness/contrast/saturation/hue with torchvision factor
+    semantics, fixed op order (see module docstring)."""
+    if "b" in p:
+        x = x * p["b"]
+    if "c" in p:
+        mean = jnp.mean(_gray(jnp.clip(x, 0.0, 1.0)))
+        x = p["c"] * x + (1.0 - p["c"]) * mean
+    if "s" in p:
+        g = _gray(jnp.clip(x, 0.0, 1.0))[..., None]
+        x = p["s"] * x + (1.0 - p["s"]) * g
+    if "h" in p:
+        x = _shift_hue(jnp.clip(x, 0.0, 1.0), p["h"])
+    return jnp.clip(x, 0.0, 1.0)
+
+
+class DeviceAugment:
+    """Config-typed on-device augmentation pipeline.
+
+    Geometry (all composed into one warp): ``scale`` is a log2 zoom range,
+    ``stretch`` a log2 per-axis aspect jitter, ``rotate`` the max rotation
+    in degrees, ``jitter`` the max window translation in pixels (the crop
+    substitute: the sampling window shifts, the shape stays bucketed) and
+    ``translate`` the max frame-2 differential shift in pixels (adds to
+    the flow, like the host ``translate``); ``flip`` gives (horizontal,
+    vertical) probabilities. Photometrics: ``brightness``/``contrast``/
+    ``saturation``/``hue`` factor ranges with ``prob_asymmetric`` as in
+    the host color jitter, ``noise`` a (lo, hi) stddev range, and
+    ``occlusion``/``occlusion_num``/``occlusion_size`` the frame-2 eraser.
+
+    ``bound(range)`` attaches the model's normalized value range (from the
+    input spec) so photometric math happens on [0, 1]; ``describe()``
+    yields the stable token used as the ProgramKey ``augment`` flag.
+    """
+
+    def __init__(self, scale=(-0.1, 0.3), stretch=0.05, rotate=0.0,
+                 translate=4.0, jitter=8.0, flip=(0.5, 0.1),
+                 brightness=0.4, contrast=0.4, saturation=0.4, hue=0.1,
+                 prob_asymmetric=0.2, noise=(0.0, 0.02), occlusion=0.5,
+                 occlusion_num=(1, 3), occlusion_size=(10, 60),
+                 th_valid=0.99, seed=0, range=(-1.0, 1.0)):
+        self.scale = (float(scale[0]), float(scale[1]))
+        self.stretch = float(stretch)
+        self.rotate = float(rotate)
+        self.translate = float(translate)
+        self.jitter = float(jitter)
+        self.flip = (float(flip[0]), float(flip[1]))
+        self.brightness = float(brightness)
+        self.contrast = float(contrast)
+        self.saturation = float(saturation)
+        self.hue = float(hue)
+        self.prob_asymmetric = float(prob_asymmetric)
+        self.noise = (float(noise[0]), float(noise[1]))
+        self.occlusion = float(occlusion)
+        self.occlusion_num = (int(occlusion_num[0]), int(occlusion_num[1]))
+        self.occlusion_size = (int(occlusion_size[0]),
+                               int(occlusion_size[1]))
+        self.th_valid = float(th_valid)
+        self.seed = int(seed)
+        self.range = (float(range[0]), float(range[1]))
+
+    @classmethod
+    def from_config(cls, cfg):
+        cfg = dict(cfg or {})
+        ty = cfg.pop("type", "device")
+        if ty != "device":
+            raise ValueError(f"invalid device augmentation type '{ty}'")
+        return cls(**{k.replace("-", "_"): v for k, v in cfg.items()})
+
+    def get_config(self):
+        return {
+            "type": "device",
+            "scale": list(self.scale),
+            "stretch": self.stretch,
+            "rotate": self.rotate,
+            "translate": self.translate,
+            "jitter": self.jitter,
+            "flip": list(self.flip),
+            "brightness": self.brightness,
+            "contrast": self.contrast,
+            "saturation": self.saturation,
+            "hue": self.hue,
+            "prob-asymmetric": self.prob_asymmetric,
+            "noise": list(self.noise),
+            "occlusion": self.occlusion,
+            "occlusion-num": list(self.occlusion_num),
+            "occlusion-size": list(self.occlusion_size),
+            "th-valid": self.th_valid,
+            "seed": self.seed,
+        }
+
+    def bound(self, range):
+        cfg = self.get_config()
+        cfg.pop("type")
+        return DeviceAugment(
+            **{k.replace("-", "_"): v for k, v in cfg.items()}, range=range)
+
+    def describe(self):
+        """Stable identity token for the ProgramKey ``augment`` flag."""
+        blob = repr(sorted(
+            (k, repr(v)) for k, v in self.get_config().items()
+        )) + repr(self.range)
+        return "dev-" + hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+    # -- keying -------------------------------------------------------------
+
+    def batch_keys(self, sample_ids, epoch):
+        """Per-sample keys from ``(sample_id, epoch)`` — see docstring."""
+        base = jax.random.fold_in(jax.random.PRNGKey(self.seed),
+                                  jnp.asarray(epoch, jnp.uint32))
+        return jax.vmap(lambda s: jax.random.fold_in(base, s))(
+            jnp.asarray(sample_ids, jnp.uint32))
+
+    # -- transform stages ---------------------------------------------------
+
+    def _geometric(self, key, img1, img2, flow, valid):
+        h, w = img1.shape[0], img1.shape[1]
+        ks = jax.random.split(key, 6)
+
+        s = 2.0 ** jax.random.uniform(
+            ks[0], (), minval=self.scale[0], maxval=self.scale[1])
+        st = 2.0 ** jax.random.uniform(
+            ks[1], (2,), minval=-self.stretch, maxval=self.stretch)
+        ang = jnp.deg2rad(jax.random.uniform(
+            ks[2], (), minval=-self.rotate, maxval=self.rotate))
+        fl = jax.random.uniform(ks[3], (2,))
+        sh = jnp.where(fl[0] < self.flip[0], -1.0, 1.0)  # horizontal: x
+        sv = jnp.where(fl[1] < self.flip[1], -1.0, 1.0)  # vertical: y
+        jit = jax.random.uniform(
+            ks[4], (2,), minval=-self.jitter, maxval=self.jitter)
+        delta = jax.random.uniform(
+            ks[5], (2,), minval=-self.translate, maxval=self.translate)
+
+        # forward map M (input -> output) in (y, x): rotation ∘ scale/flip
+        sy = s * st[0] * sv
+        sx = s * st[1] * sh
+        ca, sa = jnp.cos(ang), jnp.sin(ang)
+        m00, m01 = ca * sy, -sa * sx
+        m10, m11 = sa * sy, ca * sx
+
+        det = m00 * m11 - m01 * m10
+        a00, a01 = m11 / det, -m01 / det
+        a10, a11 = -m10 / det, m00 / det
+        mat = jnp.stack((jnp.stack((a00, a01)), jnp.stack((a10, a11))))
+
+        # the output center maps onto the (jittered) input center
+        cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+        off = jnp.stack(((cy + jit[0]) - (a00 * cy + a01 * cx),
+                         (cx + jit[1]) - (a10 * cy + a11 * cx)))
+
+        return warp_affine(img1, img2, flow, valid, mat, off, delta,
+                           self.th_valid)
+
+    def _has_jitter(self):
+        return any((self.brightness, self.contrast, self.saturation,
+                    self.hue))
+
+    def _draw_jitter(self, key):
+        kb, kc, ks, kh = jax.random.split(key, 4)
+        p = {}
+        if self.brightness:
+            p["b"] = jax.random.uniform(
+                kb, (), minval=max(0.0, 1.0 - self.brightness),
+                maxval=1.0 + self.brightness)
+        if self.contrast:
+            p["c"] = jax.random.uniform(
+                kc, (), minval=max(0.0, 1.0 - self.contrast),
+                maxval=1.0 + self.contrast)
+        if self.saturation:
+            p["s"] = jax.random.uniform(
+                ks, (), minval=max(0.0, 1.0 - self.saturation),
+                maxval=1.0 + self.saturation)
+        if self.hue:
+            p["h"] = jax.random.uniform(
+                kh, (), minval=-self.hue, maxval=self.hue)
+        return p
+
+    def _occlude(self, key, x):
+        h, w = x.shape[0], x.shape[1]
+        kp, kn, kr = jax.random.split(key, 3)
+        on = jax.random.uniform(kp, ()) < self.occlusion
+        num = jax.random.randint(kn, (), self.occlusion_num[0],
+                                 self.occlusion_num[1] + 1)
+        mean = jnp.mean(x, axis=(0, 1))
+        yy = jnp.arange(h)[:, None]
+        xx = jnp.arange(w)[None, :]
+        for i in range(self.occlusion_num[1]):
+            k1, k2 = jax.random.split(jax.random.fold_in(kr, i))
+            pos = jax.random.randint(k1, (2,), 0, jnp.array([h, w]))
+            sz = jax.random.randint(k2, (2,), self.occlusion_size[0],
+                                    self.occlusion_size[1] + 1)
+            hit = (on & (i < num)
+                   & (yy >= pos[0]) & (yy < pos[0] + sz[0])
+                   & (xx >= pos[1]) & (xx < pos[1] + sz[1]))
+            x = jnp.where(hit[..., None], mean, x)
+        return x
+
+    def _photometric(self, key, img1, img2):
+        if not (self._has_jitter() or self.noise[1] > 0
+                or self.occlusion > 0):
+            return img1, img2  # fully disabled: bit-exact passthrough
+
+        lo, hi = self.range
+        x1 = (img1 - lo) / (hi - lo)
+        x2 = (img2 - lo) / (hi - lo)
+        kj, ka, kn, ko = jax.random.split(key, 4)
+
+        if self._has_jitter():
+            kj1, kj2 = jax.random.split(kj)
+            p1 = self._draw_jitter(kj1)
+            p2 = self._draw_jitter(kj2)
+            asym = jax.random.uniform(ka, ()) < self.prob_asymmetric
+            p2 = jax.tree.map(lambda a, b: jnp.where(asym, b, a), p1, p2)
+            x1 = _apply_jitter(x1, p1)
+            x2 = _apply_jitter(x2, p2)
+
+        if self.noise[1] > 0:
+            kn0, kn1, kn2 = jax.random.split(kn, 3)
+            std = jax.random.uniform(kn0, (), minval=self.noise[0],
+                                     maxval=self.noise[1])
+            x1 = jnp.clip(x1 + std * jax.random.normal(kn1, x1.shape),
+                          0.0, 1.0)
+            x2 = jnp.clip(x2 + std * jax.random.normal(kn2, x2.shape),
+                          0.0, 1.0)
+
+        if self.occlusion > 0:
+            # forward semantics: erase in frame 2 (occlusions in the
+            # target frame, as the host occlusion-forward)
+            x2 = self._occlude(ko, x2)
+
+        return lo + (hi - lo) * x1, lo + (hi - lo) * x2
+
+    def _augment_one(self, key, img1, img2, flow, valid):
+        kgeo, kphoto = jax.random.split(key)
+        img1, img2, flow, valid = self._geometric(
+            kgeo, img1, img2, flow, valid)
+        img1, img2 = self._photometric(kphoto, img1, img2)
+        return img1, img2, flow, valid
+
+    def apply(self, keys, img1, img2, flow, valid):
+        """Augment one decoded batch under per-sample ``keys`` [B, 2]."""
+        return jax.vmap(self._augment_one)(keys, img1, img2, flow, valid)

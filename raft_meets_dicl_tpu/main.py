@@ -128,6 +128,13 @@ def main():
                             "D=-1 fills the remaining devices (also: "
                             "RMD_MESH or the env config's 'parallel' "
                             "section)")
+    train.add_argument("--device-aug", action="store_true", dest="device_aug",
+                       help="compile the augmentation pipeline into the "
+                            "train step (on-device data engine): one fused "
+                            "inverse-affine warp + elementwise photometric "
+                            "ops under per-sample (sample_id, epoch) keys "
+                            "(also: RMD_DEVICE_AUG or the env config's "
+                            "'augment' section, which tunes the parameters)")
     train.add_argument("--accumulate", type=int, metavar="K",
                        help="in-step gradient accumulation: scan K "
                             "microbatches per optimizer step inside the "

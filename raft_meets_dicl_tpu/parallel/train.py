@@ -90,7 +90,8 @@ class TrainState(struct.PyTreeNode):
 def make_train_step(model, loss_fn, tx, mesh=None, loss_args=None,
                     model_args=None, donate=True, external_lr=False,
                     with_grads=False, wire=None, nonfinite=None,
-                    state_sharding=None, accumulate=1, key=None):
+                    state_sharding=None, accumulate=1, key=None,
+                    augment=None):
     """Build the jitted training step, registered as a compiled program.
 
     Static per-stage configuration (``model_args``, ``loss_args``) is baked
@@ -150,11 +151,31 @@ def make_train_step(model, loss_fn, tx, mesh=None, loss_args=None,
     anonymously: compile events still attribute to 'train_step', but the
     program is private to the caller (the right default here, since the
     ``tx``/``loss_fn`` closures have no stable identity of their own).
+
+    ``augment`` (a ``data.device_augment.DeviceAugment``) compiles the
+    augmentation pipeline into the step: the public signature grows two
+    trailing arguments ``(sample_ids [B] uint32, epoch int32)``, and the
+    decoded batch is warped/jittered on device under per-sample keys
+    derived from ``(sample_id, epoch)`` — deterministic and resumable.
+    The augmented program registers as a flag variant
+    (``augment=<token>`` appended to ``key``); ``augment=None`` keeps the
+    historical signature and key byte-identical, so existing registered
+    programs, pins, and AOT artifacts are untouched.
     """
     loss_args = dict(loss_args or {})
     model_args = dict(model_args or {})
     guard = nonfinite == "skip"
     accumulate = max(1, int(accumulate))
+
+    # the augmented step is a distinct program: extend a caller key that
+    # doesn't already carry the flag (mirrors make_eval_step's args flag)
+    if (augment is not None and key is not None
+            and not any(n == "augment" for n, _ in key.flags)):
+        from ..compile import ProgramKey, flag_items
+
+        key = ProgramKey(kind=key.kind, model=key.model,
+                         flags=key.flags
+                         + flag_items(augment=augment.describe()))
 
     # gather-compute only when the layout actually shards something: the
     # degenerate all-replicated sharding keeps the historical program
@@ -164,9 +185,15 @@ def make_train_step(model, loss_fn, tx, mesh=None, loss_args=None,
     repl_one = partition.replicated(mesh) if mesh is not None else None
     bspec = partition.batch_spec(mesh) if mesh is not None else None
 
-    def forward(params, batch_stats, img1, img2, flow, valid):
+    def forward(params, batch_stats, img1, img2, flow, valid, keys=None):
         if wire is not None:
             img1, img2, flow, valid = wire.decode(img1, img2, flow, valid)
+        if augment is not None:
+            # on-device augmentation of the decoded (normalized) batch,
+            # keyed per sample — inside the grad-free data path, XLA
+            # schedules it alongside the forward's first convs
+            img1, img2, flow, valid = augment.apply(
+                keys, img1, img2, flow, valid)
 
         def compute_loss(p):
             out, new_bs = model.apply(
@@ -179,15 +206,19 @@ def make_train_step(model, loss_fn, tx, mesh=None, loss_args=None,
 
         return jax.value_and_grad(compute_loss, has_aux=True)(params)
 
-    def step(state, lr, img1, img2, flow, valid):
+    def step(state, lr, img1, img2, flow, valid, sample_ids=None,
+             epoch=None):
         # ZeRO-style gather: one all-gather of the sharded params for the
         # compute graph; XLA overlaps it with the first encoder convs
         params = (jax.lax.with_sharding_constraint(state.params, repl_one)
                   if gather else state.params)
 
+        keys = (augment.batch_keys(sample_ids, epoch)
+                if augment is not None else None)
+
         if accumulate == 1:
             (loss, (new_bs, final)), grads = forward(
-                params, state.batch_stats, img1, img2, flow, valid)
+                params, state.batch_stats, img1, img2, flow, valid, keys)
         else:
             # k microbatches through one scan: gradients sum into a
             # params-sized accumulator, batch stats chain microbatch to
@@ -203,6 +234,10 @@ def make_train_step(model, loss_fn, tx, mesh=None, loss_args=None,
                 return x
 
             micro = jax.tree.map(split, (img1, img2, flow, valid))
+            if augment is not None:
+                # per-sample keys split with their samples; re-derive the
+                # leading-axis layout the same way the batch does
+                micro = micro + (split(keys),)
 
             def body(carry, mb):
                 bs, gsum, lsum = carry
@@ -280,12 +315,24 @@ def make_train_step(model, loss_fn, tx, mesh=None, loss_args=None,
         return new_state, aux
 
     if external_lr:
-        public = step
+        if augment is not None:
+            # exact-arity wrapper: jit sharding specs match positionally
+            def public(state, lr, img1, img2, flow, valid, sample_ids,
+                       epoch):
+                return step(state, lr, img1, img2, flow, valid,
+                            sample_ids, epoch)
+        else:
+            public = step
         n_lead = 2  # (state, lr, ...)
     else:
         # bind a dummy lr so the public signature stays (state, batch...)
-        def public(state, img1, img2, flow, valid):
-            return step(state, 0.0, img1, img2, flow, valid)
+        if augment is not None:
+            def public(state, img1, img2, flow, valid, sample_ids, epoch):
+                return step(state, 0.0, img1, img2, flow, valid,
+                            sample_ids, epoch)
+        else:
+            def public(state, img1, img2, flow, valid):
+                return step(state, 0.0, img1, img2, flow, valid)
 
         n_lead = 1
 
@@ -294,10 +341,13 @@ def make_train_step(model, loss_fn, tx, mesh=None, loss_args=None,
     # per-program, and (stable key + AOT store on) owns the serialized
     # executables
     if mesh is None:
-        return register_step(
+        prog = register_step(
             "train_step",
             jax.jit(public, donate_argnums=(0,) if donate else ()),
             key=key)
+        if augment is not None:
+            prog.augment = augment
+        return prog
 
     repl = partition.replicated(mesh)
     data = partition.data_sharding(mesh)
@@ -311,7 +361,10 @@ def make_train_step(model, loss_fn, tx, mesh=None, loss_args=None,
                                   if gather else repl)
 
     in_shardings = (state_in,) + (None,) * (n_lead - 1) + (data,) * 4
-    return register_step("train_step", _with_data_axis(
+    if augment is not None:
+        # sample ids shard with their samples; the epoch scalar replicates
+        in_shardings = in_shardings + (data, None)
+    prog = register_step("train_step", _with_data_axis(
         mesh.devices.size,
         jax.jit(
             public,
@@ -319,6 +372,9 @@ def make_train_step(model, loss_fn, tx, mesh=None, loss_args=None,
             out_shardings=(state_in, aux_shardings),
             donate_argnums=(0,) if donate else (),
         )), key=key)
+    if augment is not None:
+        prog.augment = augment
+    return prog
 
 
 def make_eval_step(model, mesh=None, model_args=None, wire=None,

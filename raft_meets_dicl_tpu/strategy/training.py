@@ -24,6 +24,7 @@ import jax
 import numpy as np
 
 from .. import telemetry, utils
+from ..data import device_augment
 from ..telemetry import blackbox, goodput
 from ..telemetry import steptrace as steptrace_mod
 from ..parallel import (
@@ -207,7 +208,7 @@ class TrainingContext:
                  loss, input, inspector, checkpoints, mesh=None,
                  step_limit=None, loader_args={}, wire=None,
                  eval_buckets=None, nonfinite=None, partitioner=None,
-                 accumulate=1):
+                 accumulate=1, augment=None):
         self.root_log = log
         self.log = log
         self.path = Path(path)
@@ -240,6 +241,13 @@ class TrainingContext:
         # legacy host-normalized f32 batches.
         self.wire = (wire.bound(input.clip, input.range)
                      if wire is not None else None)
+        # on-device augmentation (data.device_augment.DeviceAugment):
+        # compiled into the train step as a ProgramKey flag variant, keyed
+        # per (sample_id, epoch). Bound to the input spec's value range so
+        # photometric math happens on [0, 1]. None = host-side (or no)
+        # augmentation, historical step signature and program identity.
+        self.augment = (augment.bound(tuple(input.range))
+                        if augment is not None else None)
         # shape buckets for the validation passes (models.input.ShapeBuckets):
         # mixed-resolution validation sets batch per bucket and compile at
         # most one val-step program per bucket
@@ -638,6 +646,7 @@ class TrainingContext:
             # a repeat boot of the same stage config starts stepping
             # without a single compile when the program store is warm
             key=self._train_step_key(stage, with_grads),
+            augment=self.augment,
         )
 
         self._accum = 0
@@ -724,6 +733,12 @@ class TrainingContext:
         if self.mesh is not None:
             mesh_key = (tuple(self.mesh.shape.items()),
                         tuple(d.id for d in self.mesh.devices.flat))
+        # the augment flag exists only on the augmented variant: with
+        # device augmentation off, the key (and thus program identity,
+        # AOT artifact, and budget pin) stays byte-identical to before
+        aflags = {}
+        if self.augment is not None:
+            aflags["augment"] = self.augment.describe()
         return programs.ProgramKey(
             kind="train_step", model=self.model_id,
             flags=programs.flag_items(
@@ -735,6 +750,7 @@ class TrainingContext:
                            else None),
                 accumulate=self.accumulate,
                 with_grads=with_grads,
+                **aflags,
             ))
 
     def run_epoch(self, log, stage, epoch):
@@ -752,6 +768,13 @@ class TrainingContext:
 
         self.model_adapter.on_epoch(stage, epoch, **stage.model_on_epoch_args)
         self.inspector.on_epoch_start(log, self, stage, epoch)
+
+        # advance epoch-seeded host augmentation BEFORE the loader starts
+        # iterating (decode workers fork per iteration, so they capture
+        # the value); keyed per (sample_id, epoch) like the device path
+        src = getattr(stage.data, "source", None)
+        if src is not None and hasattr(src, "set_epoch"):
+            src.set_epoch(epoch)
 
         base_put = ((lambda b: shard_batch(b, self.mesh))
                     if self.mesh is not None else jax.device_put)
@@ -1063,7 +1086,15 @@ class TrainingContext:
 
         tele = telemetry.get()
         with tele.span("dispatch"):
-            self.state, aux = self.step_fn(self.state, lr, *dev)
+            if self.augment is not None:
+                # device augmentation: per-sample ids + the epoch scalar
+                # key the on-device draws; ids derive from the metadata
+                # so they are independent of shuffle order and resume
+                ids = device_augment.sample_id_array(meta)
+                self.state, aux = self.step_fn(
+                    self.state, lr, *dev, ids, np.int32(epoch))
+            else:
+                self.state, aux = self.step_fn(self.state, lr, *dev)
         self._dispatched += 1
         strace.mark("dispatched")
 

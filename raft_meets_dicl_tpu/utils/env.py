@@ -117,6 +117,15 @@ KNOBS = dict([
     _k("RMD_EVAL_BUCKETS", "str", None,
        "shape-bucket spec for evaluation/validation ('group' or "
        "'HxW,HxW,...')", "input"),
+    _k("RMD_DEVICE_AUG", "flag", False,
+       "compile the augmentation pipeline into the train step (on-device "
+       "data engine); env-config 'augment:' section tunes it", "input"),
+    _k("RMD_SYNTH_LAYERS", "int", 4,
+       "default moving-layer count for the synthetic scene generator "
+       "(data 'type: synth'; per-source 'layers:' wins)", "input"),
+    _k("RMD_SYNTH_SEED", "int", 0,
+       "default base seed of the synthetic scene generator (per-source "
+       "'seed:' wins)", "input"),
     # -- training loop -----------------------------------------------------
     _k("RMD_PREFETCH", "switch", True,
        "double-buffered host-to-device prefetch (0 = synchronous "

@@ -272,7 +272,8 @@ def test_budget_pin_roundtrip_and_version_gate(tmp_path):
 def audited():
     """One shared audit pass over the full program set (flagship n=2,
     the (4, 2)-mesh ZeRO variant, every ladder rung, the video warm
-    variant) against the committed budget — the expensive compiles
+    variant, the quant tier, the augmented train step and the synth
+    renderer) against the committed budget — the expensive compiles
     happen once per module."""
     entries = cost.build_entries()
     budget = cost.Budget.load(REPO / cost.BUDGET_NAME)
@@ -284,13 +285,17 @@ def test_budget_gate_green_on_committed_pins(audited):
     _, rep = audited
     assert rep.ok, cost.render_reports(rep)
     assert rep.stale == [], f"stale budget pins: {rep.stale}"
-    n = 11 if jax.device_count() >= 8 else 9
+    n = 13 if jax.device_count() >= 8 else 11
     assert len(rep.reports) == n
     # the video warm-start variant is part of the audited set
     assert any("'warm', 'True'" in r["key"] for r in rep.reports)
     # ... as are the quantized matching-tier variants (u8/i8 base rung
     # plus the u8 warm frame)
     assert sum("'quant'" in r["key"] for r in rep.reports) == 3
+    # ... and the on-device data engine: the augmented train-step flag
+    # variant plus the synth renderer
+    assert sum("'augment'" in r["key"] for r in rep.reports) == 1
+    assert any("'synth_pair'" in r["key"] for r in rep.reports)
     # every audited program is pinned, and pinned exactly
     pinned = set(json.loads(
         (REPO / cost.BUDGET_NAME).read_text())["entries"])

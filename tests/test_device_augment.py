@@ -1,0 +1,435 @@
+"""On-device data-engine tests (PR 19): host/device augmentation parity
+under fixed transform parameters, fused-warp flow remapping, stateless
+(sample_id, epoch) keying, synthetic-generator exactness, and the
+augment=off program-identity contract."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_meets_dicl_tpu.data import augment as haug
+from raft_meets_dicl_tpu.data import device_augment, synth
+from raft_meets_dicl_tpu.data.collection import Metadata, SampleArgs, SampleId
+from raft_meets_dicl_tpu.data.device_augment import DeviceAugment, warp_affine
+
+pytestmark = pytest.mark.aug
+
+
+def _sample(h=16, w=20, seed=0):
+    rng = np.random.default_rng(seed)
+    img1 = rng.random((h, w, 3), np.float32)
+    img2 = rng.random((h, w, 3), np.float32)
+    flow = rng.normal(size=(h, w, 2)).astype(np.float32)
+    valid = np.ones((h, w), bool)
+    return img1, img2, flow, valid
+
+
+def _meta(i=0, ds="fake"):
+    return Metadata(True, ds, SampleId(f"s{i}", SampleArgs(), SampleArgs()),
+                    ((0, 16), (0, 20)))
+
+
+# -- fused warp: host parity under fixed parameters -------------------------
+
+
+def test_warp_crop_bit_exact_vs_host():
+    img1, img2, flow, valid = _sample()
+    y0, x0, ch, cw = 3, 5, 8, 10
+
+    h1, h2, hf, hv, _ = haug._crop(img1[None], img2[None], flow[None],
+                                   valid[None], [_meta()], x0, y0, cw, ch)
+    d1, d2, df, dv = warp_affine(img1, img2, flow, valid,
+                                 mat=np.eye(2), offset=(y0, x0),
+                                 out_shape=(ch, cw))
+    np.testing.assert_array_equal(np.asarray(d1), h1[0])
+    np.testing.assert_array_equal(np.asarray(d2), h2[0])
+    np.testing.assert_array_equal(np.asarray(df), hf[0])
+    np.testing.assert_array_equal(np.asarray(dv), hv[0])
+
+
+def test_warp_hflip_bit_exact_vs_host():
+    img1, img2, flow, valid = _sample()
+    w = img1.shape[1]
+
+    aug = haug.Flip([1.0, 0.0])  # always horizontal
+    h1, h2, hf, hv, _ = aug(img1[None], img2[None], flow[None], valid[None],
+                            [_meta()])
+    d1, d2, df, dv = warp_affine(img1, img2, flow, valid,
+                                 mat=[[1.0, 0.0], [0.0, -1.0]],
+                                 offset=(0.0, w - 1.0))
+    np.testing.assert_array_equal(np.asarray(d1), h1[0])
+    np.testing.assert_array_equal(np.asarray(d2), h2[0])
+    np.testing.assert_allclose(np.asarray(df), hf[0], atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(dv), hv[0])
+
+
+def test_warp_vflip_bit_exact_vs_host():
+    img1, img2, flow, valid = _sample()
+    h = img1.shape[0]
+
+    aug = haug.Flip([0.0, 1.0])  # always vertical
+    h1, h2, hf, hv, _ = aug(img1[None], img2[None], flow[None], valid[None],
+                            [_meta()])
+    d1, d2, df, dv = warp_affine(img1, img2, flow, valid,
+                                 mat=[[-1.0, 0.0], [0.0, 1.0]],
+                                 offset=(h - 1.0, 0.0))
+    np.testing.assert_array_equal(np.asarray(d1), h1[0])
+    np.testing.assert_allclose(np.asarray(df), hf[0], atol=1e-6)
+
+
+def test_warp_translate_matches_host_semantics():
+    """The frame-2 delta shift adds (tx, ty) to the flow and shifts img2
+    against img1 — the host Translate contract, checked on the
+    overlapping region."""
+    img1, img2, flow, valid = _sample()
+    ty, tx = 2, 3
+
+    d1, d2, df, dv = warp_affine(img1, img2, flow, valid,
+                                 mat=np.eye(2), offset=(0.0, 0.0),
+                                 delta=(float(ty), float(tx)))
+    np.testing.assert_array_equal(np.asarray(d1), img1)
+    # img2 samples at q - delta: output pixel (y, x) reads img2[y-ty, x-tx]
+    np.testing.assert_array_equal(np.asarray(d2)[ty:, tx:],
+                                  img2[:-ty, :-tx])
+    np.testing.assert_allclose(np.asarray(df),
+                               flow + np.array([tx, ty], np.float32),
+                               atol=1e-6)
+
+
+def test_warp_zoom_scales_flow_vectors():
+    img1, img2, flow, valid = _sample()
+    h, w = img1.shape[:2]
+    # 2x zoom: inverse map halves coordinates; vectors must double
+    d1, d2, df, dv = warp_affine(img1, img2, flow, valid,
+                                 mat=[[0.5, 0.0], [0.0, 0.5]],
+                                 offset=(0.0, 0.0), out_shape=(2 * h, 2 * w))
+    # at even output pixels the source coordinate is exact: flow doubles
+    np.testing.assert_allclose(np.asarray(df)[::2, ::2], 2.0 * flow,
+                               rtol=1e-5, atol=1e-5)
+    # and matches the host dense-scale contract (cv2 resize * scale) on
+    # grid-aligned points
+    np.testing.assert_array_equal(np.asarray(d1)[::2, ::2], img1)
+
+
+def test_warp_zoom_matches_host_scale_interior():
+    """Device bilinear zoom vs the host cv2.INTER_LINEAR resize: same
+    pixel-centered sampling model, small fixed-point tolerance."""
+    img1, img2, flow, valid = _sample()
+    h, w = img1.shape[:2]
+    aug = haug.Scale([0, 0], 2.0, 2.0, 0.0, 0.0, "linear", th_valid=0.99)
+    h1, _, hf, _, _ = aug(img1[None], img2[None], flow[None], valid[None],
+                          [_meta()])
+    # cv2's resize maps output p to input (p + 0.5)/s - 0.5
+    d1, _, df, _ = warp_affine(img1, img2, flow, valid,
+                               mat=[[0.5, 0.0], [0.0, 0.5]],
+                               offset=(-0.25, -0.25), out_shape=(2 * h, 2 * w))
+    np.testing.assert_allclose(np.asarray(d1)[2:-2, 2:-2],
+                               h1[0][2:-2, 2:-2], atol=2e-3)
+    np.testing.assert_allclose(np.asarray(df)[2:-2, 2:-2],
+                               hf[0][2:-2, 2:-2], rtol=0.02, atol=0.02)
+
+
+def test_warp_rotation_rotates_flow_vectors():
+    """Constant flow under a pure rotation: vectors rotate by the host
+    Rotate formula (u = cos·f0 + sin·f1, v = -sin·f0 + cos·f1)."""
+    img1, img2, _, valid = _sample(24, 24)
+    f0, f1 = 1.5, -0.5
+    flow = np.broadcast_to(np.array([f0, f1], np.float32),
+                           (24, 24, 2)).copy()
+    a = np.deg2rad(10.0)
+    c, s = np.cos(a), np.sin(a)
+    cy = cx = (24 - 1) / 2.0
+    # inverse map: rotate output coords by -a about the center (image-space
+    # y grows downward, so the host's "+a" is the clockwise matrix here)
+    mat = np.array([[c, s], [-s, c]], np.float32)
+    offset = np.array([cy - c * cy - s * cx, cx + s * cy - c * cx],
+                      np.float32)
+    _, _, df, dv = warp_affine(img1, img2, flow, valid, mat=mat,
+                               offset=offset)
+    expect = np.array([c * f0 + s * f1, -s * f0 + c * f1], np.float32)
+    interior = np.asarray(dv)[6:-6, 6:-6]
+    assert interior.all()
+    np.testing.assert_allclose(np.asarray(df)[6:-6, 6:-6],
+                               np.broadcast_to(expect, (12, 12, 2)),
+                               rtol=1e-4, atol=1e-4)
+
+
+# -- photometric / occlusion / noise semantics ------------------------------
+
+
+def test_occlusion_only_touches_img2_mean_fill():
+    aug = DeviceAugment(scale=(0, 0), stretch=0, rotate=0, translate=0,
+                        jitter=0, flip=(0, 0), brightness=0, contrast=0,
+                        saturation=0, hue=0, noise=(0, 0), occlusion=1.0,
+                        occlusion_num=(2, 2), occlusion_size=(4, 6),
+                        range=(0.0, 1.0))
+    img1, img2, flow, valid = _sample()
+    keys = aug.batch_keys(np.array([5], np.uint32), 0)
+    o1, o2, of, ov = aug.apply(keys, jnp.asarray(img1)[None],
+                               jnp.asarray(img2)[None],
+                               jnp.asarray(flow)[None],
+                               jnp.asarray(valid)[None])
+    np.testing.assert_array_equal(np.asarray(o1)[0], img1)  # frame 1 intact
+    np.testing.assert_array_equal(np.asarray(of)[0], flow)
+    diff = np.any(np.asarray(o2)[0] != img2, axis=-1)
+    assert diff.any(), "eraser patch did not fire at probability 1"
+    # erased pixels carry the (patch-free) image mean color
+    mean = img2.mean(axis=(0, 1))
+    changed = np.asarray(o2)[0][diff]
+    np.testing.assert_allclose(changed, np.broadcast_to(mean, changed.shape),
+                               atol=1e-5)
+
+
+def test_noise_bounded_and_frames_differ():
+    aug = DeviceAugment(scale=(0, 0), stretch=0, rotate=0, translate=0,
+                        jitter=0, flip=(0, 0), brightness=0, contrast=0,
+                        saturation=0, hue=0, noise=(0.05, 0.05),
+                        occlusion=0.0, range=(0.0, 1.0))
+    img1, img2, flow, valid = _sample()
+    keys = aug.batch_keys(np.array([5], np.uint32), 0)
+    o1, o2, _, _ = aug.apply(keys, jnp.asarray(img1)[None],
+                             jnp.asarray(img2)[None],
+                             jnp.asarray(flow)[None],
+                             jnp.asarray(valid)[None])
+    o1, o2 = np.asarray(o1)[0], np.asarray(o2)[0]
+    assert o1.min() >= 0.0 and o1.max() <= 1.0
+    assert not np.array_equal(o1, img1)
+    # independent draws per frame
+    assert not np.array_equal(o1 - img1, o2 - img2)
+
+
+def test_photometric_disabled_is_identity():
+    aug = DeviceAugment(scale=(0, 0), stretch=0, rotate=0, translate=0,
+                        jitter=0, flip=(0, 0), brightness=0, contrast=0,
+                        saturation=0, hue=0, noise=(0, 0), occlusion=0.0)
+    img1, img2, flow, valid = _sample()
+    keys = aug.batch_keys(np.array([5], np.uint32), 0)
+    o1, o2, of, ov = aug.apply(keys, jnp.asarray(img1)[None],
+                               jnp.asarray(img2)[None],
+                               jnp.asarray(flow)[None],
+                               jnp.asarray(valid)[None])
+    np.testing.assert_array_equal(np.asarray(o1)[0], img1)
+    np.testing.assert_array_equal(np.asarray(o2)[0], img2)
+    np.testing.assert_array_equal(np.asarray(of)[0], flow)
+    np.testing.assert_array_equal(np.asarray(ov)[0], valid)
+
+
+# -- stateless keying -------------------------------------------------------
+
+
+def test_keys_deterministic_and_epoch_dependent():
+    aug = DeviceAugment(seed=3)
+    ids = np.array([7, 11], np.uint32)
+    k0 = np.asarray(aug.batch_keys(ids, 0))
+    k0b = np.asarray(aug.batch_keys(ids, 0))
+    k1 = np.asarray(aug.batch_keys(ids, 1))
+    np.testing.assert_array_equal(k0, k0b)
+    assert not np.array_equal(k0, k1)
+    assert not np.array_equal(k0[0], k0[1])  # per-sample keys differ
+
+
+def test_apply_bit_identical_across_instances():
+    """A rebuilt DeviceAugment with the same config (a resume) draws the
+    same augmentations for the same (sample_id, epoch)."""
+    cfg = dict(rotate=3.0, translate=2.0, jitter=2.0, seed=9)
+    img1, img2, flow, valid = _sample()
+    args = (jnp.asarray(img1)[None], jnp.asarray(img2)[None],
+            jnp.asarray(flow)[None], jnp.asarray(valid)[None])
+    ids = np.array([42], np.uint32)
+    a = DeviceAugment(**cfg).apply(DeviceAugment(**cfg).batch_keys(ids, 2),
+                                   *args)
+    b = DeviceAugment(**cfg).apply(DeviceAugment(**cfg).batch_keys(ids, 2),
+                                   *args)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_sample_id_array_stable():
+    ids1 = device_augment.sample_id_array([_meta(0), _meta(1)])
+    ids2 = device_augment.sample_id_array([_meta(1), _meta(0)])
+    np.testing.assert_array_equal(ids1, ids2[::-1])  # order-independent
+    assert ids1[0] != ids1[1]
+    assert device_augment.sample_id_array([_meta(0, "other")])[0] != ids1[0]
+
+
+def test_describe_tracks_config():
+    a, b = DeviceAugment(), DeviceAugment(rotate=5.0)
+    assert a.describe() != b.describe()
+    assert a.describe() == DeviceAugment().describe()
+    assert a.describe().startswith("dev-")
+    # from_config round-trips kebab-case keys
+    c = DeviceAugment.from_config(a.get_config())
+    assert c.describe() == a.describe()
+
+
+# -- host RNG threading (seeded Generator path) -----------------------------
+
+
+class _Src:
+    def __init__(self, n=4, h=16, w=20):
+        self.n, self.h, self.w = n, h, w
+
+    def __len__(self):
+        return self.n
+
+    def get_config(self):
+        return {"type": "fake"}
+
+    def __getitem__(self, i):
+        rng = np.random.default_rng(i)
+        return (rng.random((1, self.h, self.w, 3), np.float32),
+                rng.random((1, self.h, self.w, 3), np.float32),
+                rng.normal(size=(1, self.h, self.w, 2)).astype(np.float32),
+                np.ones((1, self.h, self.w), bool),
+                [Metadata(True, "fake",
+                          SampleId(f"s{i}", SampleArgs(), SampleArgs()),
+                          ((0, self.h), (0, self.w)))])
+
+
+def _host_augs():
+    return [haug.ColorJitter(0.3, 0.4, 0.4, 0.4, 0.1),
+            haug.Flip([0.5, 0.5]),
+            haug.NoiseNormal([0.0, 0.02])]
+
+
+def test_host_augment_seeded_ignores_global_rng():
+    a = haug.Augment(_host_augs(), _Src(), sync=True, seed=7)
+    np.random.seed(0)
+    r1 = a[2]
+    np.random.rand(100)  # perturb the global stream
+    np.random.seed(99)
+    r2 = a[2]
+    np.testing.assert_array_equal(r1[0], r2[0])
+    np.testing.assert_array_equal(r1[1], r2[1])
+
+
+def test_host_augment_epoch_resume():
+    a = haug.Augment(_host_augs(), _Src(), sync=True, seed=7)
+    r0 = a[2]
+    a.set_epoch(1)
+    r1 = a[2]
+    assert not (np.array_equal(r0[0], r1[0]) and np.array_equal(r0[1], r1[1]))
+    a.set_epoch(0)  # mid-training resume back into epoch 0
+    np.testing.assert_array_equal(a[2][0], r0[0])
+
+
+def test_host_augment_legacy_seed_uses_global_rng():
+    a = haug.Augment(_host_augs(), _Src(), sync=True, seed="legacy")
+    np.random.seed(5)
+    r1 = a[2]
+    np.random.seed(5)
+    r2 = a[2]
+    np.testing.assert_array_equal(r1[0], r2[0])
+    assert a.get_config()["seed"] == "legacy"
+
+
+# -- synthetic scenario generator -------------------------------------------
+
+
+def test_synth_deterministic_and_shaped():
+    imgs, flows, valids = synth.render_sequence(jax.random.PRNGKey(3),
+                                                (32, 48), frames=3)
+    assert imgs.shape == (3, 32, 48, 3)
+    assert flows.shape == (2, 32, 48, 2)
+    assert valids.shape == (2, 32, 48)
+    imgs2, flows2, _ = synth.render_sequence(jax.random.PRNGKey(3),
+                                             (32, 48), frames=3)
+    np.testing.assert_array_equal(np.asarray(imgs), np.asarray(imgs2))
+    np.testing.assert_array_equal(np.asarray(flows), np.asarray(flows2))
+
+
+def test_synth_flow_is_exact():
+    """Backward-warping frame 2 by the generated flow reproduces frame 1
+    on valid pixels — the generator's ground truth is exact, not
+    approximate."""
+    i1, i2, flow, valid = synth.render_pair(jax.random.PRNGKey(0), (48, 64),
+                                            motion=4.0)
+    i1, i2 = np.asarray(i1), np.asarray(i2)
+    flow, valid = np.asarray(flow), np.asarray(valid)
+    h, w = i1.shape[:2]
+    yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    qy = yy + flow[..., 1]
+    qx = xx + flow[..., 0]
+    warped = np.asarray(device_augment._bilinear(
+        jnp.asarray(i2), jnp.asarray(qy, jnp.float32),
+        jnp.asarray(qx, jnp.float32)))
+    err = np.abs(warped - i1)[valid]
+    assert valid.mean() > 0.5, "valid mask degenerate"
+    assert err.mean() < 0.02, f"flow not exact: mean abs err {err.mean():.4f}"
+
+
+def test_synth_perturbations_finite():
+    i1, *_ = synth.render_pair(jax.random.PRNGKey(0), (32, 48))
+    for kind in synth.PERTURBATIONS:
+        out = synth.perturb(jax.random.PRNGKey(1), i1, kind, 0.5)
+        out = np.asarray(out)
+        assert np.isfinite(out).all(), kind
+        assert out.shape == i1.shape, kind
+        assert not np.array_equal(out, np.asarray(i1)), kind
+
+
+def test_synth_collection_protocol():
+    col = synth.Synth.from_config(".", {
+        "type": "synth", "size": 4, "shape": [32, 48]})
+    assert len(col) == 4
+    img1, img2, flow, valid, meta = col[1]
+    assert img1.shape == (1, 32, 48, 3)
+    assert flow.shape == (1, 32, 48, 2)
+    assert meta[0].valid and meta[0].dataset_id == "synth"
+    cfg = col.get_config()
+    assert cfg["type"] == "synth"
+    # deterministic by (seed, index)
+    again = synth.Synth.from_config(".", {
+        "type": "synth", "size": 4, "shape": [32, 48]})
+    np.testing.assert_array_equal(again[1][0], img1)
+
+
+def test_synth_perturbation_suite():
+    base = synth.Synth.from_config(".", {
+        "type": "synth", "size": 2, "shape": [32, 48]})
+    suite = synth.perturbation_suite(base, severities=(0.5,))
+    assert set(suite) == {f"{k}-0.5" for k in synth.PERTURBATIONS}
+    img1, *_ = suite["fog-0.5"][0]
+    assert np.isfinite(img1).all()
+
+
+# -- program identity (augment=off contract) --------------------------------
+
+
+def test_augment_off_returns_identical_program():
+    """make_train_step(augment=None) must return the very Program object
+    registered without the flag — existing keys, pins, and AOT artifacts
+    stay untouched. Build-only: nothing compiles until the step is
+    called."""
+    import optax
+
+    import raft_meets_dicl_tpu.models as models
+    from raft_meets_dicl_tpu import compile as programs, parallel
+
+    spec = models.load({
+        "name": "tiny", "id": "tiny-augtest",
+        "model": {"type": "raft/baseline",
+                  "parameters": {"corr-levels": 2, "corr-radius": 2,
+                                 "corr-channels": 32,
+                                 "context-channels": 16,
+                                 "recurrent-channels": 16}},
+        "loss": {"type": "raft/sequence"},
+        "input": None,
+    })
+    tx = optax.sgd(1e-3)
+    key = programs.ProgramKey(kind="train_step", model="tiny-augtest",
+                              flags=programs.flag_items(t="aug-identity"))
+    plain = parallel.make_train_step(spec.model, spec.loss, tx, key=key)
+    off = parallel.make_train_step(spec.model, spec.loss, tx, key=key,
+                                   augment=None)
+    assert off is plain
+
+    on = parallel.make_train_step(spec.model, spec.loss, tx, key=key,
+                                  augment=DeviceAugment())
+    assert on is not plain
+    flags = dict(on.key.flags)
+    assert flags.get("augment") == repr(DeviceAugment().describe())
+    # the plain key is still registered unchanged
+    assert programs.registry().get(plain.key) is plain
