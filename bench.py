@@ -15,6 +15,7 @@ repo publishes no throughput numbers of its own).
 
 import json
 import os
+import threading
 import time
 
 import jax
@@ -514,10 +515,14 @@ def _bench_serve():
     compiles (AOT hits only) and serving the full stream the same way.
     Budget permitting, a fourth phase streams fast-class requests
     through a ladder'd replica on the quantized matching tier
-    (``BENCH_SERVE_QUANT``, default u8; see ``ops.quant``). Reports
-    p50/p99 latency, wall + steady-state pairs/s, and shed/error counts;
-    every phase row carries a ``quant`` field. One cumulative JSON line
-    per phase; consumers read the last."""
+    (``BENCH_SERVE_QUANT``, default u8; see ``ops.quant``), and a fifth
+    runs the serving-fleet kill/rejoin drill (two video replicas behind
+    the router, skewed mix + sticky stream, one replica hard-killed
+    mid-stream and rejoining warm from the published AOT store;
+    ``BENCH_FLEET_FRAMES`` sizes the stream). Reports p50/p99 latency,
+    wall + steady-state pairs/s, and shed/error counts; every phase row
+    carries a ``quant`` field. One cumulative JSON line per phase;
+    consumers read the last."""
     import shutil
     import tempfile
 
@@ -715,6 +720,94 @@ def _bench_serve():
                     rungs=(iters, 2 * iters, 3 * iters)),
                 classes=["fast"])
         _emit(result)
+
+    # phase 5 (budget permitting): the serving fleet (PR 20) — two video
+    # replicas behind the router, a skewed bucket mix plus one sticky
+    # stream, and the kill/rejoin chaos drill: a replica is hard-killed
+    # mid-stream, every affected request ends in a result or a *typed*
+    # shed, the stream pays at most one cold frame, and the rejoining
+    # replica boots against the published AOT store with zero compiles.
+    elapsed = time.monotonic() - t_start
+    if elapsed * 2 > budget_s:
+        result["fleet_skipped"] = f"budget ({elapsed:.0f}s elapsed)"
+        print(f"SKIPPED fleet phase: budget "
+              f"({elapsed:.0f}s of {budget_s:.0f}s used)", flush=True)
+        _emit(result)
+        return result
+
+    from raft_meets_dicl_tpu import fleet as fleet_mod
+    from raft_meets_dicl_tpu.serve.observe import Observer
+
+    store = tempfile.mkdtemp(prefix="bench-serve-fleet-aot-")
+    replicas = {}
+
+    def boot_replica(index):
+        programs.reset()
+        evaluation._EVAL_FN_CACHE.clear()
+        spec = models.load(model_cfg)
+        session = serve.ServeSession(
+            spec, minput.ShapeBuckets(bucket_sizes), wire=wire,
+            batch_size=batch, video=True)
+        outcomes = session.warm_pool()
+        programs.publish(store)
+        sched = serve.Scheduler(session, max_wait_ms=20.0,
+                                queue_limit=64).start()
+        obs = Observer(session, sched)
+        server = fleet_mod.serve_replica(session, sched, obs, 0,
+                                         index=index)
+        return {"session": session, "scheduler": sched, "server": server,
+                "compiles": sum(o["compiles"] for o in outcomes),
+                "aot_hits": sum(o["aot_hits"] for o in outcomes)}
+
+    try:
+        programs.enable_aot(store)
+        codec = fleet_mod.EdgeCodec(
+            minput.ShapeBuckets(bucket_sizes), wire=wire)
+        router = fleet_mod.Router(codec, retries=2)
+        boot_compiles = {}
+        for i in range(2):
+            replicas[i] = boot_replica(i)
+            boot_compiles[f"replica-{i}"] = replicas[i]["compiles"]
+            router.add_replica(f"replica-{i}", replicas[i]["server"].url)
+
+        def kill(owner):
+            index = int(owner.rsplit("-", 1)[1]) if owner else 0
+            name = f"replica-{index}"
+            replicas[index]["server"].close()
+            replicas[index]["scheduler"].stop(drain=False)
+            router.mark_down(name, reason="drill kill")
+
+            def rejoin():
+                replicas[index] = boot_replica(index)
+                router.add_replica(name, replicas[index]["server"].url)
+
+            threading.Thread(target=rejoin, daemon=True).start()
+            return name
+
+        frames = int(os.environ.get("BENCH_FLEET_FRAMES", "16"))
+        drill_report = fleet_mod.run_drill(
+            router, kill, bucket_sizes, frames=frames,
+            kill_after=frames // 3, background_per_frame=2,
+            rejoin_wait_s=max(60.0, budget_s - (time.monotonic()
+                                                - t_start)))
+        router.stop()
+        result["fleet"] = {
+            "replicas": 2,
+            "boot_compiles": boot_compiles,
+            "drill": drill_report,
+            "zero_compile_rejoin":
+                drill_report["rejoin_compiles"] == 0,
+        }
+    finally:
+        for rep in replicas.values():
+            try:
+                rep["server"].close()
+                rep["scheduler"].stop(drain=False)
+            except Exception:
+                pass
+        programs.disable_aot()
+        shutil.rmtree(store, ignore_errors=True)
+    _emit(result)
     return result
 
 

@@ -116,6 +116,15 @@ def check(module):
 
 SIDECAR_RULE = "sidecar-route"
 SIDECAR_MODULE = "raft_meets_dicl_tpu/telemetry/sidecar.py"
+# every module whose module-level ROUTES tuple is a served HTTP surface:
+# the observability sidecar plus the fleet's replica API and router
+# front-end. The sidecar module is required (missing ROUTES there is a
+# finding); the others are checked when present.
+ROUTE_MODULES = (
+    SIDECAR_MODULE,
+    "raft_meets_dicl_tpu/fleet/replica.py",
+    "raft_meets_dicl_tpu/fleet/router.py",
+)
 
 
 def _sidecar_routes(module):
@@ -134,33 +143,39 @@ def _sidecar_routes(module):
 
 
 def check_sidecar_routes(ctx):
-    """Every route the sidecar serves must appear in README.md (the
-    observability table documents the endpoint surface)."""
-    module = next((m for m in ctx.modules if m.rel == SIDECAR_MODULE), None)
-    if module is None:
-        # partial --root runs don't cover the sidecar; nothing to hold
-        return []
-    parsed = _sidecar_routes(module)
-    if parsed is None:
-        return [Finding(
-            rule=SIDECAR_RULE, path=SIDECAR_MODULE, line=1,
-            message="telemetry/sidecar.py has no module-level ROUTES "
-                    "tuple of string literals; the sidecar-route rule "
-                    "anchors the documented endpoint surface on it")]
-    lineno, routes = parsed
+    """Every route a ROUTES-declaring HTTP module serves must appear in
+    README.md (the endpoint tables document the served surface)."""
     readme = ctx.root / "README.md"
-    if not readme.exists():
-        return [Finding(rule=SIDECAR_RULE, path="README.md", line=1,
-                        message="README.md missing")]
-    text = readme.read_text()
-    return [
-        Finding(
-            rule=SIDECAR_RULE, path=SIDECAR_MODULE, line=lineno,
-            message=f"sidecar route {route!r} is not documented in "
-                    f"README.md; add it to the observability endpoint "
-                    f"table (or drop the route)")
-        for route in routes if route not in text
-    ]
+    text = readme.read_text() if readme.exists() else None
+    findings = []
+    for rel in ROUTE_MODULES:
+        module = next((m for m in ctx.modules if m.rel == rel), None)
+        if module is None:
+            # partial --root runs (or a build without the fleet) don't
+            # cover this module; nothing to hold
+            continue
+        parsed = _sidecar_routes(module)
+        if parsed is None:
+            if rel == SIDECAR_MODULE:
+                findings.append(Finding(
+                    rule=SIDECAR_RULE, path=rel, line=1,
+                    message="telemetry/sidecar.py has no module-level "
+                            "ROUTES tuple of string literals; the "
+                            "sidecar-route rule anchors the documented "
+                            "endpoint surface on it"))
+            continue
+        lineno, routes = parsed
+        if text is None:
+            return [Finding(rule=SIDECAR_RULE, path="README.md", line=1,
+                            message="README.md missing")]
+        findings.extend(
+            Finding(
+                rule=SIDECAR_RULE, path=rel, line=lineno,
+                message=f"served route {route!r} is not documented in "
+                        f"README.md; add it to the endpoint table "
+                        f"(or drop the route)")
+            for route in routes if route not in text)
+    return findings
 
 
 RULES = [
@@ -170,7 +185,8 @@ RULES = [
              "ending _total)",
          check=check),
     Rule(name=SIDECAR_RULE,
-         doc="every route in telemetry.sidecar.ROUTES must appear in "
-             "the README observability table",
+         doc="every route in a module-level ROUTES tuple (telemetry "
+             "sidecar, fleet replica API, fleet router front-end) must "
+             "appear in the README endpoint tables",
          project=check_sidecar_routes),
 ]

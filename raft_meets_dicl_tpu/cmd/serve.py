@@ -19,6 +19,11 @@ Knob precedence everywhere: CLI flag > config file (``serve:`` section)
 
 import json
 import logging
+import os
+import signal
+import subprocess
+import sys
+import threading
 from pathlib import Path
 
 from .. import models, serve as serving, utils
@@ -42,6 +47,9 @@ def _resolve(path, cfg_path):
 
 
 def serve(args):
+    if getattr(args, "fleet", None):
+        return _serve_fleet(args)
+
     utils.logging.setup()
 
     from .. import compile as programs, telemetry
@@ -141,6 +149,14 @@ def serve(args):
         spec, buckets, wire=wire, checkpoint=checkpoint,
         batch_size=batch_size, ladder=ladder, video=video, quant=quant)
 
+    aot_store = getattr(args, "aot_store", None)
+    if aot_store and not getattr(args, "prebuild", False) \
+            and programs.aot_enabled():
+        fetched = programs.fetch(aot_store)
+        logging.info(
+            f"AOT store '{aot_store}': fetched {fetched['copied']} "
+            f"programs ({fetched['present']} already local)")
+
     outcomes = session.warm_pool()
     for o in outcomes:
         rung = f" rung {o['rung']}" if "rung" in o else ""
@@ -151,7 +167,14 @@ def serve(args):
             f"({o['seconds']:.2f} s)")
 
     if getattr(args, "prebuild", False):
-        print(json.dumps({"prebuild": outcomes}))
+        published = None
+        if aot_store and programs.aot_enabled():
+            published = programs.publish(aot_store)
+            logging.info(
+                f"AOT store '{aot_store}': published "
+                f"{published['copied']} programs "
+                f"({published['present']} already there)")
+        print(json.dumps({"prebuild": outcomes, "published": published}))
         if getattr(args, "telemetry", None):
             telemetry.deactivate()
         return
@@ -164,6 +187,12 @@ def serve(args):
     scheduler = serving.Scheduler(
         session, batch_size=batch_size, max_wait_ms=max_wait_ms,
         queue_limit=queue_limit).start()
+
+    if getattr(args, "listen_port", None) is not None:
+        _serve_replica_blocking(args, session, scheduler, tele)
+        if getattr(args, "telemetry", None):
+            telemetry.deactivate()
+        return
 
     metrics_port = int(_pick(getattr(args, "metrics_port", None), cfg,
                              "metrics-port",
@@ -213,5 +242,184 @@ def serve(args):
 
     if observer is not None:
         observer.close()
+    if getattr(args, "telemetry", None):
+        telemetry.deactivate()
+
+
+def _serve_replica_blocking(args, session, scheduler, tele):
+    """Replica mode: bind the fleet API, write the port-file rendezvous,
+    block until SIGTERM/SIGINT, then drain and exit cleanly."""
+    from .. import fleet
+
+    index = int(getattr(args, "replica_index", 0) or 0)
+    observer = serving.Observer(session, scheduler, sink=tele)
+    server = fleet.serve_replica(
+        session, scheduler, observer, int(args.listen_port), index=index)
+    logging.info(
+        f"replica {index} serving at {server.url}: /v1/flow /sessionz "
+        f"/drainz + /metrics /healthz /statusz /profilez")
+    port_file = getattr(args, "port_file", None)
+    if port_file:
+        # atomic write: the supervisor polls this file and must never
+        # read a torn port number
+        tmp = f"{port_file}.tmp"
+        Path(tmp).write_text(f"{server.port}\n")
+        os.replace(tmp, port_file)
+
+    stop = threading.Event()
+
+    def _terminate(signum, frame):
+        logging.info(f"replica {index}: signal {signum}, draining")
+        observer.begin_drain()
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _terminate)
+    signal.signal(signal.SIGINT, _terminate)
+    while not stop.wait(1.0):
+        pass
+    scheduler.stop(drain=True)
+    server.close()
+    logging.info(f"replica {index}: drained and stopped")
+
+
+def _child_argv(extra):
+    """The replica child's command line: this CLI re-entered with the
+    parent's serve flags minus the fleet-harness-only ones."""
+    strip_valued = {"--fleet", "--telemetry", "--metrics-port",
+                    "--listen-port", "--port-file", "--replica-index"}
+    strip_flags = {"--drill", "--prebuild"}
+    argv, skip = [], False
+    for a in sys.argv[1:]:
+        if skip:
+            skip = False
+            continue
+        opt = a.split("=", 1)[0]
+        if opt in strip_flags:
+            continue
+        if opt in strip_valued:
+            skip = "=" not in a
+            continue
+        argv.append(a)
+    head = [sys.executable]
+    script = sys.argv[0]
+    if script and script.endswith(".py") and Path(script).exists():
+        head.append(script)
+    else:
+        head += ["-c",
+                 "from raft_meets_dicl_tpu.main import main; main()"]
+    return head + argv + extra
+
+
+def _serve_fleet(args):
+    """Fleet mode: supervise N replica processes behind the router,
+    then drive them (open-loop load or the kill/rejoin drill)."""
+    utils.logging.setup()
+
+    from .. import fleet, telemetry
+    from ..models.input import ShapeBuckets
+    from ..models.wire import WireFormat
+    from ..utils import env
+
+    tele = telemetry.get()
+    if getattr(args, "telemetry", None):
+        tele = telemetry.activate(
+            telemetry.create(Path(args.telemetry), nonblocking=True))
+        if tele.path:
+            logging.info(f"writing telemetry events to '{tele.path}'")
+
+    cfg = {}
+    if getattr(args, "config", None):
+        cfg = utils.config.load(args.config)
+        cfg = cfg.get("serve", cfg)
+    buckets = ShapeBuckets.from_config(
+        _pick(args.buckets, cfg, "buckets", env.raw("RMD_SERVE_BUCKETS")))
+    if buckets is None or not buckets.sizes:
+        raise ValueError(
+            "fleet mode needs explicit bucket sizes: --buckets 'HxW,...', "
+            "the config's 'buckets' key, or RMD_SERVE_BUCKETS")
+    wire = WireFormat.from_config(
+        _pick(getattr(args, "wire_format", None), cfg, "wire-format",
+              env.get_str("RMD_WIRE_FORMAT")))
+    ladder_spec = _pick(getattr(args, "ladder", None), cfg, "ladder", None)
+    video = bool(_pick(getattr(args, "video", None) or None, cfg,
+                       "video", None))
+
+    n = int(args.fleet) if int(args.fleet) > 0 \
+        else env.get_int("RMD_FLEET_REPLICAS")
+    logging.info(f"fleet: {n} replicas, buckets {buckets.describe()}"
+                 + (f", wire {wire.describe()}" if wire else ""))
+
+    def spawn(index, port_file):
+        argv = _child_argv(["--listen-port", "0",
+                            "--port-file", port_file,
+                            "--replica-index", str(index)])
+        return subprocess.Popen(argv, env=os.environ.copy())
+
+    codec = fleet.EdgeCodec(buckets, wire=wire)
+    router = fleet.Router(codec).start()
+    sup = fleet.Supervisor(
+        spawn, n,
+        on_up=lambda i, url: router.add_replica(f"replica-{i}", url),
+        on_down=lambda i: router.mark_down(f"replica-{i}"))
+    router.on_recycle = lambda name: sup.recycle(
+        int(name.rsplit("-", 1)[1]))  # graftlint: disable=host-sync -- parses a replica name, not a device value
+
+    frontend = None
+    report = {}
+    try:
+        sup.start(wait_ready=True)
+        for slot in sup.slots:
+            if slot.url:
+                router.add_replica(slot.name, slot.url)
+        ready = sum(1 for s in router.replicas().values() if s.eligible())
+        if ready == 0:
+            raise RuntimeError("fleet: no replica came up healthy")
+        logging.info(f"fleet: {ready}/{n} replicas ready")
+
+        metrics_port = int(_pick(getattr(args, "metrics_port", None), cfg,
+                                 "metrics-port",
+                                 env.get_int("RMD_METRICS_PORT")) or 0)
+        if metrics_port:
+            frontend = fleet.serve_frontend(router, metrics_port)
+            logging.info(f"fleet front-end at {frontend.url}: /v1/flow "
+                         f"/fleetz /healthz")
+
+        shapes = []
+        for h, w in buckets.sizes:
+            shapes.append((h, w))
+            if h > 8 and w > 8:
+                shapes.append((h - 8, w - 8))
+        classes = list(serving.CLASSES) if ladder_spec else None
+        if video:
+            classes = None
+
+        if getattr(args, "drill", False):
+            def kill(owner):
+                index = int(owner.rsplit("-", 1)[1]) if owner else 0  # graftlint: disable=host-sync -- parses a replica name, not a device value
+                logging.info(f"drill: hard-killing replica-{index}")
+                sup.kill(index)
+                return f"replica-{index}"
+
+            report = fleet.run_drill(
+                router, kill, shapes,
+                classes=tuple(classes) if classes else (None,),
+                frames=int(_pick(args.requests, cfg, "requests", 24)))
+            report = {"fleet": n, "drill": report}
+        else:
+            requests = int(_pick(args.requests, cfg, "requests", 32))
+            rate = float(_pick(args.rate, cfg, "rate", 50.0))
+            report = serving.loadgen.run_open_loop(
+                router, shapes, requests=requests, rate_hz=rate,
+                classes=classes, sequence=video)
+            report = {"fleet": n, **report}
+    finally:
+        report["router"] = router.describe()
+        report["supervisor"] = sup.describe()
+        if frontend is not None:
+            frontend.close()
+        router.stop()
+        sup.stop()
+
+    print(json.dumps(report))
     if getattr(args, "telemetry", None):
         telemetry.deactivate()

@@ -14,7 +14,8 @@ Public surface:
 
 from . import aot
 from .aot import (
-    aot_enabled, artifact_path, disable_aot, enable_aot, fingerprint,
+    aot_enabled, artifact_path, disable_aot, enable_aot, fetch, fingerprint,
+    publish,
     programs_dir,
 )
 from .registry import (
@@ -28,5 +29,6 @@ __all__ = [
     "flag_items", "register_step", "registry", "reset",
     "shape_signature", "unstable",
     "aot_enabled", "artifact_path", "disable_aot", "enable_aot",
+    "fetch", "publish",
     "fingerprint", "programs_dir",
 ]

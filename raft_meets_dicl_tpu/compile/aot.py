@@ -114,6 +114,81 @@ def tombstoned(path):
         return False
 
 
+def _validate_artifact(path):
+    """Cheap record validation without deserializing the executable:
+    magic, runtime-fingerprint match (same jax/backend/topology), CRC.
+    Returns (ok, reason)."""
+    try:
+        with open(path, "rb") as fd:
+            record = pickle.loads(fd.read())
+    except Exception as e:  # noqa: BLE001 - any decode failure
+        return False, f"unpickle: {type(e).__name__}"
+    if not isinstance(record, dict) or record.get("magic") != _MAGIC:
+        return False, "bad magic"
+    if record.get("fingerprint") != fingerprint():
+        return False, (f"fingerprint '{record.get('fingerprint')}' vs "
+                       f"runtime '{fingerprint()}'")
+    payload = record.get("payload")
+    if payload is None or zlib.crc32(payload) != record.get("crc"):
+        return False, "crc mismatch"
+    return True, "ok"
+
+
+def _copy_artifacts(src, dest, event):
+    """Validated artifact transfer between program stores (the fleet
+    distribution primitive): every ``*.rmdp`` whose record passes
+    :func:`_validate_artifact` is copied atomically; invalid or
+    version-mismatched artifacts are skipped (never raising), existing
+    destination files are left alone (content-addressed names — same
+    name means same program). Tombstones stay local: they record a
+    host-specific load failure, not a property of the artifact.
+    Returns ``{copied, present, invalid, artifacts}``.
+    """
+    import glob as _glob
+
+    from .. import telemetry
+
+    os.makedirs(dest, exist_ok=True)
+    copied, present, invalid = [], 0, {}
+    for path in sorted(_glob.glob(os.path.join(src, "*.rmdp"))):
+        name = os.path.basename(path)
+        target = os.path.join(dest, name)
+        if os.path.exists(target):
+            present += 1
+            continue
+        ok, reason = _validate_artifact(path)
+        if not ok:
+            invalid[name] = reason
+            continue
+        tmp = f"{target}.tmp.{os.getpid()}"
+        with open(path, "rb") as sfd, open(tmp, "wb") as dfd:
+            dfd.write(sfd.read())
+        os.replace(tmp, target)
+        copied.append(name)
+    out = {"copied": len(copied), "present": present,
+           "invalid": len(invalid), "artifacts": copied}
+    telemetry.get().emit("aot", event=event, src=str(src), dest=str(dest),
+                         **{k: out[k] for k in
+                            ("copied", "present", "invalid")})
+    return out
+
+
+def publish(dest, src=None):
+    """Publish the local program store into a shared fleet store: one
+    ``serve --prebuild`` host exports its compiled executables, every
+    replica fetches them. Only artifacts matching the *current* runtime
+    fingerprint travel — that is the same-topology portability check."""
+    return _copy_artifacts(src or programs_dir(), dest, "publish")
+
+
+def fetch(src, dest=None):
+    """Pull published artifacts into the local program store (replica
+    boot): validated against the local runtime fingerprint, so an
+    artifact built on a different jax/backend/topology is skipped and
+    that program simply JIT-compiles."""
+    return _copy_artifacts(src, dest or programs_dir(), "fetch")
+
+
 def save(path, key, sig, compiled):
     """Serialize ``compiled`` (a jax.stages.Compiled) to ``path``
     atomically. Returns (nbytes, seconds); raises on failure — callers

@@ -1,0 +1,288 @@
+"""Replica-side fleet API: one serve process behind the router.
+
+Extends the shared observability sidecar (PR 13: /metrics /healthz
+/statusz /profilez) with the serving surface one replica exposes to the
+fleet — one HTTP server, one port, one route table:
+
+- ``POST /v1/flow`` — inference: wire-encoded request bytes admitted
+  straight through ``Scheduler.submit_encoded`` (no re-encode), the
+  response flow in the session's wire flow dtype. Typed sheds/errors map
+  to status codes (fleet/wire.py) so the router can account and retry
+  without parsing prose.
+- ``GET /sessionz?client=X`` / ``GET /sessionz`` — export one sticky
+  video session's carry snapshot (handoff source) / list live sessions.
+- ``POST /sessionz`` — install a handed-off carry snapshot (handoff
+  target); validation failures answer 400 and the stream restarts cold.
+- ``POST /drainz`` — begin drain: /healthz flips to 503 with a
+  ``draining`` body, new /v1/flow requests shed typed ``draining``,
+  queued/in-flight work still completes.
+
+The chaos triggers (testing.faults) live here, keyed by the replica
+index: ``slow_replica`` sleeps before handling, ``hang_replica`` wedges
+request handling (the process stays up — the router's per-request
+deadline is what must save the client), ``kill_replica`` hard-exits the
+process after N completed requests (``os._exit``: no drain, no goodbye
+— the supervisor and router must cope).
+"""
+
+import json
+import logging
+import os
+import threading
+import time
+from urllib.parse import parse_qs, urlparse
+
+from .. import telemetry
+from ..serve.batcher import ServeError, ServeRejected
+from ..telemetry import sidecar
+from ..testing import faults
+from ..utils import env
+from ..video.cache import CarryMismatch
+from . import wire as fwire
+
+# every route this replica serves beyond the inherited observability
+# sidecar table; graftlint:sidecar-route checks these against README
+ROUTES = ("/v1/flow", "/sessionz", "/drainz")
+
+# hard exit code for the kill_replica chaos trigger: distinguishable
+# from a python crash (1) and a clean drain (0) in supervisor logs
+KILL_EXIT_CODE = 17
+
+
+class ReplicaAPI:
+    """Request handling + fault hooks for one replica process."""
+
+    def __init__(self, session, scheduler, observer, index=0,
+                 timeout_s=None):
+        self.session = session
+        self.scheduler = scheduler
+        self.observer = observer
+        self.index = int(index)
+        if timeout_s is None:
+            timeout_s = env.get_float("RMD_FLEET_TIMEOUT_MS") / 1e3
+        self.timeout_s = float(timeout_s)
+        self._served = 0
+        self._hang_until = 0.0
+        self._lock = threading.Lock()
+
+    # -- chaos hooks ---------------------------------------------------------
+
+    def _fault_hooks(self):
+        """Fire any armed fleet triggers at this replica's coordinates.
+
+        ``after=N`` pins the trigger to fire once N requests have
+        *completed* on this replica (so a kill lands mid-stream, not at
+        boot); omitted, it fires on the first request. The counter check
+        runs on every request until the directive's budget is consumed.
+        """
+        with self._lock:
+            served = self._served
+        if faults.fire("kill_replica", replica=self.index, after=served) \
+                is not None:
+            logging.warning(
+                f"fault kill_replica: replica {self.index} hard-exiting "
+                f"after {served} served requests")
+            os._exit(KILL_EXIT_CODE)
+        p = faults.fire("hang_replica", replica=self.index, after=served)
+        if p is not None:
+            self._hang_until = time.monotonic() + float(
+                p.get("seconds", 3600))
+        p = faults.fire("slow_replica", replica=self.index)
+        if p is not None:
+            time.sleep(float(p.get("ms", 250)) / 1e3)
+        hang = self._hang_until - time.monotonic()
+        if hang > 0:
+            time.sleep(hang)
+
+    # -- /v1/flow ------------------------------------------------------------
+
+    def handle_flow(self, meta, body):
+        """One inference request → ``(status, meta, body | None)``."""
+        self._fault_hooks()
+        if self.observer.draining():
+            return 503, {"error": "draining", "type": "rejected"}, None
+        try:
+            e1, e2, shape = fwire.unpack_pair(
+                meta, body, expect_dtype=self.session.image_dtype())
+            ticket = self.scheduler.submit_encoded(
+                e1, e2, shape,
+                client=str(meta.get("client", "default")),
+                klass=meta.get("klass"),
+                sequence=bool(meta.get("sequence", False)))
+        except ServeRejected as e:
+            return (fwire.STATUS_BY_REJECT.get(e.reason, 503),
+                    {"error": e.reason, "type": "rejected",
+                     "detail": str(e)}, None)
+        except ServeError as e:
+            return (fwire.STATUS_BY_ERROR.get(e.kind, 500),
+                    {"error": e.kind, "type": "error",
+                     "detail": str(e)}, None)
+        try:
+            result = ticket.result(timeout=self.timeout_s)
+        except TimeoutError:
+            return (504, {"error": "timeout", "type": "error",
+                          "detail": f"no result in {self.timeout_s} s"},
+                    None)
+        except ServeError as e:
+            return (fwire.STATUS_BY_ERROR.get(e.kind, 500),
+                    {"error": e.kind, "type": "error",
+                     "detail": str(e)}, None)
+        with self._lock:
+            self._served += 1
+        wire = getattr(self.session, "wire", None)
+        flow_dtype = ("float16" if wire is not None and wire.flow == "f16"
+                      else "float32")
+        out_meta, out_body = fwire.pack_result(result, flow_dtype)
+        out_meta["replica"] = self.index
+        return 200, out_meta, out_body
+
+    # -- /sessionz -----------------------------------------------------------
+
+    def _sessions(self):
+        return getattr(self.scheduler, "sessions", None)
+
+    def export_session(self, client):
+        sessions = self._sessions()
+        if sessions is None:
+            return 400, {"error": "no_video",
+                         "detail": "replica serves no video sessions"}
+        snapshot = sessions.export_carry(client)
+        if snapshot is None:
+            return 404, {"error": "no_session", "client": client}
+        snapshot["replica"] = self.index
+        return 200, snapshot
+
+    def list_sessions(self):
+        sessions = self._sessions()
+        clients = sessions.clients() if sessions is not None else []
+        return 200, {"clients": clients, "replica": self.index}
+
+    def import_session(self, snapshot):
+        sessions = self._sessions()
+        if sessions is None:
+            return 400, {"error": "no_video",
+                         "detail": "replica serves no video sessions"}
+        expected = self.scheduler.carry_shapes() \
+            if hasattr(self.scheduler, "carry_shapes") else None
+        try:
+            if expected is not None and \
+                    tuple(int(d) for d in snapshot.get("shape", ())) \
+                    not in expected:
+                raise CarryMismatch(
+                    f"carry shape {snapshot.get('shape')} matches no "
+                    f"bucket's coarse grid {sorted(expected)}")
+            sessions.import_carry(snapshot)
+        except CarryMismatch as e:
+            return 400, {"error": "carry_mismatch", "detail": str(e)}
+        return 200, {"imported": snapshot.get("client"),
+                     "replica": self.index}
+
+    # -- /drainz -------------------------------------------------------------
+
+    def drain(self):
+        first = self.observer.begin_drain()
+        if first:
+            telemetry.get().emit("fleet", event="drain",
+                                 replica=self.index, source="replica")
+        return 200, {"draining": True, "first": first,
+                     "pending": self.scheduler.pending(),
+                     "replica": self.index}
+
+
+class Handler(sidecar.Handler):
+    """The sidecar handler plus the fleet serving routes.
+
+    ``observer`` (bound by SidecarServer) must be a serve Observer whose
+    ``api`` attribute is the :class:`ReplicaAPI`.
+    """
+
+    def _api(self):
+        return getattr(self.observer, "api", None)
+
+    def _send_meta(self, status, meta, body):
+        """Reply with an X-RMD-Meta header + raw body (the flow path),
+        or a plain JSON body when there is no payload."""
+        if body is None:
+            self._send_json(status, meta)
+            return
+        data = body if isinstance(body, bytes) else bytes(body)
+        self.send_response(status)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header(fwire.META_HEADER, fwire.dumps_meta(meta))
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler contract
+        url = urlparse(self.path)
+        api = self._api()
+        if url.path != "/sessionz" or api is None:
+            super().do_GET()
+            return
+        try:
+            qs = parse_qs(url.query)
+            client = qs.get("client", [None])[0]
+            if client:
+                status, payload = api.export_session(client)
+            else:
+                status, payload = api.list_sessions()
+            self._send_json(status, payload)
+        except Exception as e:  # noqa: BLE001 - a handler must not kill the replica
+            self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
+
+    def do_POST(self):  # noqa: N802 - BaseHTTPRequestHandler contract
+        url = urlparse(self.path)
+        api = self._api()
+        try:
+            if api is None:
+                self._send_json(404, {"error": f"no route {url.path}"})
+            elif url.path == "/v1/flow":
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length) if length else b""
+                try:
+                    meta = fwire.loads_meta(
+                        self.headers.get(fwire.META_HEADER))
+                except ServeError as e:
+                    self._send_json(400, {"error": e.kind, "type": "error",
+                                          "detail": str(e)})
+                    return
+                status, out_meta, out_body = api.handle_flow(meta, body)
+                self._send_meta(status, out_meta, out_body)
+            elif url.path == "/sessionz":
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    snapshot = json.loads(self.rfile.read(length))
+                except ValueError as e:
+                    self._send_json(400, {"error": "carry_mismatch",
+                                          "detail": f"bad json: {e}"})
+                    return
+                status, payload = api.import_session(snapshot)
+                self._send_json(status, payload)
+            elif url.path == "/drainz":
+                status, payload = api.drain()
+                self._send_json(status, payload)
+            else:
+                self._send_json(404, {"error": f"no route {url.path}"})
+        except Exception as e:  # noqa: BLE001 - a handler must not kill the replica
+            try:
+                self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
+            except OSError:
+                pass  # client went away mid-reply
+
+
+class ReplicaServer(sidecar.SidecarServer):
+    """One replica's single HTTP server: observability + serving API."""
+
+    def __init__(self, observer, port, host="127.0.0.1"):
+        super().__init__(observer, port, host=host,
+                         thread_name="fleet-replica", handler_cls=Handler)
+
+
+def serve_replica(session, scheduler, observer, port, index=0,
+                  timeout_s=None):
+    """Bind the fleet API onto a booted replica; returns the started
+    :class:`ReplicaServer` (``.port`` resolves port 0)."""
+    api = ReplicaAPI(session, scheduler, observer, index=index,
+                     timeout_s=timeout_s)
+    observer.api = api
+    return ReplicaServer(observer, port).start()

@@ -1,0 +1,258 @@
+"""Replica process supervisor: spawn, watch, restart with backoff.
+
+The supervisor owns the fleet's process tree. Each replica slot runs
+one serve process (``serve --listen-port 0 --port-file ...``); the
+monitor thread reaps exits and respawns crashed slots with capped
+exponential backoff (base ``RMD_FLEET_BACKOFF_MS``, doubling per
+consecutive crash, capped at 30 s, ±25 % jitter so a correlated crash
+doesn't produce a correlated thundering-herd restart). A slot that
+comes back *stays backed off* until it proves healthy: the port-file
+rendezvous plus an HTTP /healthz gate runs before the ``on_up``
+callback announces the replica to the router, so traffic never routes
+to a half-booted process.
+
+The supervisor is deliberately policy-free: it knows processes, ports
+and exit codes, not requests. Routing policy (drain, affinity, retry)
+lives in :class:`~.router.Router`; the two meet only through the
+``on_up``/``on_down`` callbacks and :meth:`recycle`.
+"""
+
+import logging
+import os
+import pathlib
+import random
+import signal
+import subprocess
+import threading
+import time
+
+from .. import telemetry
+from ..telemetry import metrics as metrics_mod
+from ..utils import env
+from .client import ReplicaClient, ReplicaDown, ReplicaTimeout
+
+# restart backoff ceiling; crashes faster than this stop accelerating
+_BACKOFF_CAP_S = 30.0
+# a replica alive this long resets its consecutive-crash counter
+_HEALTHY_RESET_S = 10.0
+# port-file + healthz rendezvous budget per boot
+_BOOT_DEADLINE_S = 120.0
+
+
+class ReplicaProc:
+    """One supervised slot: process handle + restart bookkeeping."""
+
+    def __init__(self, index):
+        self.index = int(index)
+        self.name = f"replica-{index}"
+        self.proc = None
+        self.url = None
+        self.port_file = None
+        self.crashes = 0          # consecutive, reset after healthy uptime
+        self.restarts = 0         # lifetime
+        self.started_at = 0.0
+        self.restart_after = 0.0  # monotonic gate for the next spawn
+        self.wanted = True        # False once stop()/kill(permanent) hit
+        self.reaped = True        # this death already counted/announced
+
+    def alive(self):
+        return self.proc is not None and self.proc.poll() is None
+
+
+class Supervisor:
+    """Spawn and keep N replica processes alive.
+
+    ``spawn(index, port_file)`` must return a started
+    :class:`subprocess.Popen` for slot ``index`` whose process writes
+    its bound HTTP port (decimal, one line) to ``port_file`` once
+    serving. ``on_up(index, url)`` / ``on_down(index)`` are the router
+    hookup; both run on the monitor thread.
+    """
+
+    def __init__(self, spawn, n, on_up=None, on_down=None,
+                 backoff_ms=None, poll_s=None, workdir=None):
+        self.spawn = spawn
+        self.n = int(n)
+        self.on_up = on_up
+        self.on_down = on_down
+        self.backoff_s = float(
+            backoff_ms if backoff_ms is not None
+            else env.get_float("RMD_FLEET_BACKOFF_MS")) / 1e3
+        self.poll_s = float(poll_s if poll_s is not None
+                            else env.get_float("RMD_FLEET_HEALTH_S"))
+        self.workdir = pathlib.Path(
+            workdir if workdir is not None
+            else os.environ.get("TMPDIR", "/tmp")) / f"rmd-fleet-{os.getpid()}"
+        self.slots = [ReplicaProc(i) for i in range(self.n)]
+        self._thread = None
+        self._stopping = threading.Event()
+        self._lock = threading.Lock()
+        self._m_restarts = metrics_mod.registry().counter(
+            "rmd_fleet_restarts_total",
+            "supervisor replica respawns after crash", ("replica",))
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self, wait_ready=True):
+        """Boot every slot; optionally block until all pass the health
+        gate (initial boot is sequential on purpose — N replicas racing
+        a cold compile cache would duplicate every warm-up compile)."""
+        self.workdir.mkdir(parents=True, exist_ok=True)
+        for slot in self.slots:
+            self._spawn_slot(slot)
+            if wait_ready:
+                self._await_boot(slot)
+        self._thread = threading.Thread(
+            target=self._monitor, name="fleet-supervisor", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout_s=10.0):
+        """SIGTERM every child (graceful drain path), then SIGKILL."""
+        self._stopping.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        for slot in self.slots:
+            slot.wanted = False
+            if slot.alive():
+                slot.proc.terminate()
+        deadline = time.monotonic() + timeout_s
+        for slot in self.slots:
+            if slot.proc is None:
+                continue
+            try:
+                slot.proc.wait(timeout=max(0.1,
+                                           deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                slot.proc.kill()
+                slot.proc.wait(timeout=5.0)
+
+    # -- chaos / recycling ---------------------------------------------------
+
+    def kill(self, index, permanent=False):
+        """Hard-kill one slot (drill hook). With ``permanent`` the slot
+        stays down; otherwise the monitor respawns it with backoff."""
+        slot = self.slots[index]
+        if permanent:
+            slot.wanted = False
+        if slot.alive():
+            slot.proc.send_signal(signal.SIGKILL)
+            slot.proc.wait(timeout=10.0)
+
+    def recycle(self, index):
+        """Gracefully replace one slot's process (the router calls this
+        after drain + handoff): SIGTERM, then the monitor respawns."""
+        slot = self.slots[index]
+        slot.crashes = 0  # a commanded recycle is not a crash
+        if slot.alive():
+            slot.proc.terminate()
+
+    def restore(self, index):
+        """Re-arm a slot disabled by ``kill(permanent=True)``."""
+        self.slots[index].wanted = True
+        self.slots[index].restart_after = 0.0
+
+    # -- internals -----------------------------------------------------------
+
+    def _spawn_slot(self, slot):
+        slot.port_file = self.workdir / f"{slot.name}.port"
+        try:
+            slot.port_file.unlink()
+        except FileNotFoundError:
+            pass
+        slot.proc = self.spawn(slot.index, str(slot.port_file))
+        slot.started_at = time.monotonic()
+        slot.url = None
+        slot.reaped = False
+        logging.info(f"fleet: spawned {slot.name} pid {slot.proc.pid}")
+
+    def _await_boot(self, slot, deadline_s=_BOOT_DEADLINE_S):
+        """Port-file rendezvous then /healthz gate; returns the URL or
+        None (the slot crashed or never came up — backoff applies)."""
+        deadline = time.monotonic() + deadline_s
+        port = None
+        while time.monotonic() < deadline and slot.alive():
+            try:
+                text = slot.port_file.read_text().strip()
+                if text:
+                    port = int(text)
+                    break
+            except (FileNotFoundError, ValueError):
+                pass
+            time.sleep(0.05)
+        if port is None:
+            return None
+        url = f"http://127.0.0.1:{port}"
+        client = ReplicaClient(url, timeout_s=2.0)
+        while time.monotonic() < deadline and slot.alive():
+            try:
+                payload, status = client.health()
+                # draining 503 at boot means a stale process; any
+                # /healthz answer proves the server thread is up, and
+                # ready=True proves the warm pool is built
+                if status == 200 and payload.get("ready"):
+                    slot.url = url
+                    return url
+            except (ReplicaDown, ReplicaTimeout):
+                pass
+            time.sleep(0.1)
+        return None
+
+    def _announce_up(self, slot, deadline_s=_BOOT_DEADLINE_S):
+        url = self._await_boot(slot, deadline_s=deadline_s)
+        if url is None:
+            return False
+        if self.on_up is not None:
+            self.on_up(slot.index, url)
+        return True
+
+    def _monitor(self):
+        # announce the initially-booted slots
+        for slot in self.slots:
+            if slot.alive() and slot.url and self.on_up is not None:
+                self.on_up(slot.index, slot.url)
+        while not self._stopping.wait(self.poll_s):
+            now = time.monotonic()
+            for slot in self.slots:
+                if slot.alive():
+                    if slot.crashes and \
+                            now - slot.started_at > _HEALTHY_RESET_S:
+                        slot.crashes = 0
+                    if slot.url is None:
+                        # spawned without the blocking boot gate
+                        # (wait_ready=False): keep trying the rendezvous
+                        self._announce_up(slot,
+                                          deadline_s=self.poll_s * 2)
+                    continue
+                if slot.proc is not None and not slot.reaped:
+                    # fresh death: tell the router before anything else
+                    code = slot.proc.returncode
+                    slot.reaped = True
+                    announced = slot.url is not None
+                    slot.url = None
+                    logging.warning(
+                        f"fleet: {slot.name} exited with code {code}")
+                    if announced and self.on_down is not None:
+                        self.on_down(slot.index)
+                    slot.crashes += 1
+                    backoff = min(
+                        _BACKOFF_CAP_S,
+                        self.backoff_s * (2 ** (slot.crashes - 1)))
+                    backoff *= random.uniform(0.75, 1.25)
+                    slot.restart_after = now + backoff
+                    telemetry.get().emit(
+                        "fleet", event="restart", replica=slot.index,
+                        exit_code=code, crashes=slot.crashes,
+                        backoff_ms=round(backoff * 1e3, 1))
+                if not slot.wanted or now < slot.restart_after:
+                    continue
+                slot.restarts += 1
+                self._m_restarts.labels(replica=slot.name).inc()
+                self._spawn_slot(slot)
+                self._announce_up(slot)
+
+    def describe(self):
+        return {s.name: {"alive": s.alive(), "url": s.url,
+                         "crashes": s.crashes, "restarts": s.restarts}
+                for s in self.slots}

@@ -1,0 +1,200 @@
+"""Fleet wire protocol: how a flow request crosses the network boundary.
+
+The PR-2 wire formats already define the *device* contract (u8/bf16
+images decoded inside the jitted program); this module defines the
+*HTTP* contract so client-encoded bytes land on device untouched:
+
+- request: ``POST /v1/flow`` with an ``X-RMD-Meta`` JSON header (bucket,
+  original shape, wire dtype, client, class, sequence flag) and a raw
+  body of the two bucket-padded, wire-encoded images concatenated —
+  no base64, no re-encode at any hop;
+- response: ``X-RMD-Meta`` (shape, flow dtype, class, iterations, warm
+  flag, latency spans) plus the raw flow bytes, in the session's wire
+  flow dtype (f16 under the bf16/u8 presets);
+- errors: JSON bodies with the *typed* reason — HTTP status carries the
+  shed/error class (429 ``queue_full``, 503 ``replica_unavailable`` /
+  ``draining`` / ``shutdown``, 400 payload errors, 504 deadline, 500
+  internal) so every hop can account sheds without parsing prose.
+
+:class:`EdgeCodec` is the client-side edge: it owns the bucket
+quantization + wire encode that ``serve.Scheduler.submit`` would do
+in-process, so the router (and any thin client) produces exactly the
+bytes a replica's ``submit_encoded`` admits.
+
+Numpy-only; no jax anywhere on the wire path.
+"""
+
+import json
+
+import numpy as np
+
+from ..serve.batcher import ServeError
+
+META_HEADER = "X-RMD-Meta"
+
+# HTTP status per typed shed/error: the fleet-wide backpressure contract
+STATUS_BY_REJECT = {"queue_full": 429, "shutdown": 503,
+                    "replica_unavailable": 503, "draining": 503}
+STATUS_BY_ERROR = {"malformed": 400, "oversized": 400,
+                   "unknown_class": 400, "no_video": 400,
+                   "decode": 500, "internal": 500, "timeout": 504}
+# replies on these paths never executed the request on the device, so a
+# router may safely re-dispatch them to another replica
+SAFE_RETRY_STATUS = (429, 503)
+
+
+def dumps_meta(meta):
+    return json.dumps(meta, separators=(",", ":"))
+
+
+def loads_meta(raw):
+    if not raw:
+        raise ServeError("malformed", f"missing {META_HEADER} header")
+    try:
+        meta = json.loads(raw)
+    except ValueError as e:
+        raise ServeError("malformed", f"bad {META_HEADER}: {e}") from e
+    if not isinstance(meta, dict):
+        raise ServeError("malformed", f"{META_HEADER} is not an object")
+    return meta
+
+
+class EdgeCodec:
+    """Bucket quantization + wire encoding at the client edge.
+
+    Mirrors the serve admission path exactly (`ShapeBuckets.assign` +
+    ``pad_image`` + ``WireFormat.encode_image``): the replica admits the
+    resulting arrays through ``submit_encoded`` without touching a
+    pixel. ``wire=None`` means raw f32 (no wire format configured).
+    """
+
+    def __init__(self, buckets, wire=None):
+        self.buckets = buckets
+        self.wire = wire
+
+    def image_dtype(self):
+        if self.wire is not None:
+            return self.wire.image_dtype()
+        return np.dtype(np.float32)
+
+    def flow_dtype(self):
+        if self.wire is not None and self.wire.flow == "f16":
+            return np.dtype(np.float16)
+        return np.dtype(np.float32)
+
+    def encode_image(self, img):
+        if self.wire is not None:
+            return self.wire.encode_image(img)
+        return np.ascontiguousarray(img, np.float32)
+
+    def encode_pair(self, img1, img2):
+        """Raw HWC pair → (e1, e2, bucket, shape); raises the same typed
+        ``oversized``/``malformed`` errors as in-process admission."""
+        for img in (img1, img2):
+            if not isinstance(img, np.ndarray) or img.ndim != 3 \
+                    or img.shape[-1] != 3:
+                raise ServeError(
+                    "malformed",
+                    f"expected HWC RGB arrays, got "
+                    f"{getattr(img, 'shape', type(img).__name__)}")
+        if img1.shape != img2.shape:
+            raise ServeError(
+                "malformed",
+                f"pair shapes differ: {img1.shape} vs {img2.shape}")
+        h, w = int(img1.shape[0]), int(img1.shape[1])
+        bucket = self.buckets.assign(h, w)
+        if bucket is None:
+            raise ServeError(
+                "oversized",
+                f"{h}x{w} fits no bucket ({self.buckets.describe()})")
+        e1 = self.encode_image(self.buckets.pad_image(img1, bucket))
+        e2 = self.encode_image(self.buckets.pad_image(img2, bucket))
+        return e1, e2, bucket, (h, w)
+
+    def request(self, img1, img2, client="default", klass=None,
+                sequence=False):
+        """Raw pair → ``(meta, body)`` ready for ``POST /v1/flow``."""
+        e1, e2, bucket, shape = self.encode_pair(img1, img2)
+        meta = {
+            "bucket": list(bucket),
+            "shape": list(shape),
+            "dtype": str(e1.dtype),
+            "client": client,
+            "sequence": bool(sequence),
+        }
+        if klass is not None:
+            meta["klass"] = klass
+        return meta, pack_pair(e1, e2)
+
+
+def pack_pair(e1, e2):
+    """Two equally-shaped wire arrays → one raw body (img1 then img2)."""
+    return np.ascontiguousarray(e1).tobytes() \
+        + np.ascontiguousarray(e2).tobytes()
+
+
+def unpack_pair(meta, body, expect_dtype=None):
+    """Request body → the two bucket-shaped wire arrays.
+
+    Validates the meta against the body length and (when given) the
+    serving session's wire dtype; every failure is a typed ``malformed``
+    so the replica answers 400, never 500.
+    """
+    try:
+        bucket = tuple(int(d) for d in meta["bucket"])
+        shape = tuple(int(d) for d in meta["shape"])
+        dtype = np.dtype(str(meta["dtype"]))
+    except Exception as e:  # noqa: BLE001 - anything missing/unparseable is a client error
+        raise ServeError("malformed", f"bad request meta: {e}") from e
+    if len(bucket) != 2 or len(shape) != 2:
+        raise ServeError("malformed",
+                         f"bucket/shape must be (H, W): {meta}")
+    if expect_dtype is not None and dtype != expect_dtype:
+        raise ServeError(
+            "malformed",
+            f"wire dtype {dtype} does not match the replica's "
+            f"{expect_dtype}")
+    nbytes = bucket[0] * bucket[1] * 3 * dtype.itemsize
+    if len(body) != 2 * nbytes:
+        raise ServeError(
+            "malformed",
+            f"body is {len(body)} bytes, two {bucket[0]}x{bucket[1]}x3 "
+            f"{dtype} images need {2 * nbytes}")
+    full = (bucket[0], bucket[1], 3)
+    e1 = np.frombuffer(body[:nbytes], dtype=dtype).reshape(full)
+    e2 = np.frombuffer(body[nbytes:], dtype=dtype).reshape(full)
+    return e1, e2, shape
+
+
+def pack_result(result, flow_dtype):
+    """A scheduler :class:`~..serve.batcher.FlowResult` → (meta, body)."""
+    flow = np.ascontiguousarray(result.flow, dtype=flow_dtype)
+    meta = {
+        "rid": result.rid,
+        "client": result.client,
+        "shape": list(result.shape),
+        "dtype": str(flow.dtype),
+        "klass": result.klass,
+        "iterations": result.iterations,
+        "warm": bool(result.warm),
+        "spans": {k: round(v, 6) for k, v in result.spans.items()},
+    }
+    return meta, flow.tobytes()
+
+
+def unpack_result(meta, body):
+    """Response (meta, body) → ``(flow f32, meta)``; typed ``decode``
+    error when the payload does not match its declaration."""
+    try:
+        shape = tuple(int(d) for d in meta["shape"])
+        dtype = np.dtype(str(meta["dtype"]))
+    except Exception as e:  # noqa: BLE001 - a malformed reply is a decode failure
+        raise ServeError("decode", f"bad response meta: {e}") from e
+    nbytes = shape[0] * shape[1] * 2 * dtype.itemsize
+    if len(body) != nbytes:
+        raise ServeError(
+            "decode",
+            f"flow body is {len(body)} bytes, {shape[0]}x{shape[1]}x2 "
+            f"{dtype} needs {nbytes}")
+    flow = np.frombuffer(body, dtype=dtype).reshape(shape[0], shape[1], 2)
+    return np.asarray(flow, np.float32), meta

@@ -304,6 +304,36 @@ def main():
                             "/statusz, /profilez?seconds=N (also: "
                             "RMD_METRICS_PORT, the config's "
                             "'metrics-port' key) [default: off]")
+    serve.add_argument("--fleet", type=int, metavar="N",
+                       help="fault-tolerant fleet: supervise N replica "
+                            "processes behind the routing front-end "
+                            "(least-loaded dispatch, retry, drain, "
+                            "session handoff; also: RMD_FLEET_REPLICAS) "
+                            "[default: single process]")
+    serve.add_argument("--drill", action="store_true",
+                       help="with --fleet: run the kill/rejoin chaos "
+                            "drill instead of the plain open-loop client "
+                            "(hard-kills a replica mid-stream, asserts "
+                            "typed sheds only, <=1 cold frame, warm "
+                            "rejoin)")
+    serve.add_argument("--aot-store", metavar="DIR",
+                       help="published AOT program store: --prebuild "
+                            "publishes built programs into DIR; a "
+                            "booting replica fetches from DIR before "
+                            "warming (zero-compile boot)")
+    serve.add_argument("--listen-port", type=int, metavar="PORT",
+                       help="replica mode: serve the fleet API "
+                            "(/v1/flow /sessionz /drainz + the "
+                            "observability routes) on this 127.0.0.1 "
+                            "port (0 = ephemeral) and block until "
+                            "SIGTERM drains")
+    serve.add_argument("--port-file", metavar="PATH",
+                       help="replica mode: write the bound port here "
+                            "once serving (the supervisor's rendezvous)")
+    serve.add_argument("--replica-index", type=int, default=0,
+                       metavar="I",
+                       help="replica mode: this replica's fleet slot "
+                            "index (labels telemetry + chaos triggers)")
 
     # subcommand: checkpoint
     chkpt = subp.add_parser("checkpoint", formatter_class=fmtcls,

@@ -7,14 +7,28 @@ coordinated-omission trap). The generator cycles through a
 mixed-resolution shape list, submits raw synthetic pairs at ``rate_hz``,
 collects every ticket, and reports p50/p99/mean latency, per-span means,
 throughput, and the shed/error counts.
+
+The generator is also a *well-behaved* client of the typed shed
+contract: retryable sheds (``queue_full``, ``replica_unavailable`` —
+backpressure that may clear) can re-submit with jittered exponential
+backoff up to a bounded budget, while permanent sheds (``shutdown``,
+``draining``) are never retried. Each ticket is collected under a
+per-request timeout; a ticket that completes with a typed shed (the
+fleet router resolves rejections at result time, not submit time) is
+accounted exactly like a synchronous one.
 """
 
+import random
 import time
 
 import numpy as np
 
 from ..telemetry.report import _percentile
 from .batcher import ServeError, ServeRejected
+
+# shed reasons worth a client-side retry: transient backpressure, not a
+# permanent state of the service
+RETRYABLE_SHEDS = ("queue_full", "replica_unavailable")
 
 
 def synthetic_pair(shape, rng):
@@ -25,9 +39,28 @@ def synthetic_pair(shape, rng):
     return img1, img2
 
 
+def submit_with_retry(scheduler, img1, img2, client, klass, sequence,
+                      retries, backoff_s, rejects, retried):
+    """One submission with bounded jittered-backoff retry on retryable
+    typed sheds; returns the ticket or None (shed accounted)."""
+    for attempt in range(int(retries) + 1):
+        try:
+            return scheduler.submit(img1, img2, client=client, klass=klass,
+                                    sequence=sequence)
+        except ServeRejected as e:
+            if e.reason not in RETRYABLE_SHEDS or attempt >= retries:
+                rejects[e.reason] = rejects.get(e.reason, 0) + 1
+                return None
+            retried[0] += 1
+            time.sleep(backoff_s * (2 ** attempt)
+                       * random.uniform(0.5, 1.5))
+    return None
+
+
 def run_open_loop(scheduler, shapes, requests, rate_hz, client="loadgen",
                   seed=0, result_timeout_s=120.0, classes=None,
-                  sequence=False, streams=4):
+                  sequence=False, streams=4, retries=0,
+                  retry_backoff_s=0.05):
     """Drive ``scheduler`` with ``requests`` submissions at ``rate_hz``.
 
     ``shapes`` is the (H, W) cycle the stream draws from (mixed
@@ -37,15 +70,20 @@ def run_open_loop(scheduler, shapes, requests, rate_hz, client="loadgen",
     ``sequence=True`` (video sessions) requests are submitted as
     ``streams`` interleaved sticky client streams — each stream pins one
     shape so its frames share a bucket and its carry stays valid — and
-    the report carries a warm-hit breakdown. Returns the report dict
-    (see ``summarize``); deterministic for a fixed seed, shape list, and
-    class list.
+    the report carries a warm-hit breakdown. ``retries`` > 0 re-submits
+    a retryably-shed request with jittered backoff (``retry_backoff_s``
+    base, doubling per attempt) before accounting the shed; the default
+    0 keeps the pure open-loop measurement (a retry bends the schedule,
+    which is the client's choice, not the harness's). Returns the
+    report dict (see ``summarize``); deterministic for a fixed seed,
+    shape list, and class list (retry jitter excepted).
     """
     rng = np.random.default_rng(seed)
     interval = 1.0 / float(rate_hz)
     tickets = []
     rejects = {}
     errors = {}
+    retried = [0]
 
     t_start = time.perf_counter()
     for i in range(int(requests)):
@@ -63,10 +101,11 @@ def run_open_loop(scheduler, shapes, requests, rate_hz, client="loadgen",
         img1, img2 = synthetic_pair(shape, rng)
         klass = classes[i % len(classes)] if classes else None
         try:
-            tickets.append(scheduler.submit(img1, img2, client=name,
-                                            klass=klass, sequence=sequence))
-        except ServeRejected as e:
-            rejects[e.reason] = rejects.get(e.reason, 0) + 1
+            ticket = submit_with_retry(
+                scheduler, img1, img2, name, klass, sequence,
+                retries, retry_backoff_s, rejects, retried)
+            if ticket is not None:
+                tickets.append(ticket)
         except ServeError as e:
             errors[e.kind] = errors.get(e.kind, 0) + 1
 
@@ -74,11 +113,20 @@ def run_open_loop(scheduler, shapes, requests, rate_hz, client="loadgen",
     for ticket in tickets:
         try:
             results.append(ticket.result(timeout=result_timeout_s))
+        except ServeRejected as e:
+            # fleet tickets resolve sheds at result time (the router's
+            # bounded retry already ran); account them with the rest
+            rejects[e.reason] = rejects.get(e.reason, 0) + 1
+        except TimeoutError:
+            errors["timeout"] = errors.get("timeout", 0) + 1
         except ServeError as e:
             errors[e.kind] = errors.get(e.kind, 0) + 1
     wall = time.perf_counter() - t_start
 
-    return summarize(int(requests), results, rejects, errors, wall)
+    report = summarize(int(requests), results, rejects, errors, wall)
+    if retried[0]:
+        report["retries"] = retried[0]
+    return report
 
 
 def summarize(requests, results, rejects, errors, wall_s):

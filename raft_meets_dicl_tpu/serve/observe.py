@@ -51,6 +51,7 @@ class Observer:
         self.sink = sink
         self.registry = registry or metrics_mod.registry()
         self.stale_heartbeat_s = float(stale_heartbeat_s)  # graftlint: disable=host-sync -- config scalar, not a device value
+        self._draining = False
         self._profile_lock = threading.Lock()
         self._m_ready = self.registry.gauge(
             "rmd_serve_ready", "replica readiness (warm pool complete)")
@@ -79,6 +80,19 @@ class Observer:
     def live(self):
         return self.heartbeat_age() < self.stale_heartbeat_s
 
+    def draining(self):
+        return self._draining
+
+    def begin_drain(self):
+        """Flip the replica into draining: /healthz goes 503 with a
+        ``draining`` body so external probes and the fleet router share
+        one signal. In-flight and queued requests still complete (the
+        scheduler keeps dispatching); only *routing* decisions change.
+        Idempotent; returns True on the first transition."""
+        first = not self._draining
+        self._draining = True
+        return first
+
     def _refresh_gauges(self):
         self._m_ready.set(1.0 if self.ready() else 0.0)
         self._m_heartbeat.set(round(self.heartbeat_age(), 3))
@@ -100,11 +114,17 @@ class Observer:
     def health(self):
         ready, age = self.ready(), self.heartbeat_age()
         live = age < self.stale_heartbeat_s
-        return {
+        payload = {
             "ready": ready,
             "live": live,
             "heartbeat_age_s": round(age, 3),
-        }, (200 if ready and live else 503)
+        }
+        if self._draining:
+            # a draining replica is deliberately unhealthy to probes:
+            # finish what it holds, take nothing new
+            payload["draining"] = True
+            return payload, 503
+        return payload, (200 if ready and live else 503)
 
     def status(self):
         sched = self.scheduler
@@ -115,10 +135,13 @@ class Observer:
                   if hasattr(sched, "queue_depths") else {})
         return {
             "ready": self.ready(),
+            "draining": self._draining,
             "heartbeat_age_s": round(self.heartbeat_age(), 3),
             "queues": depths,
             "pending": sum(depths.values()),
             "requests": snap.get("count", 0),
+            "compiles": (self.session.compiles()
+                         if hasattr(self.session, "compiles") else None),
             "classes": snap.get("classes", {}),
             "tail": snap.get("tail"),
             "slo": slo.snapshot() if slo else {},

@@ -213,11 +213,80 @@ class Scheduler:
 
         e1, e2 = self.batcher.encode_pair(img1, img2, bucket,
                                           self.session.encode_image)
+        return self._enqueue(rid, client, bucket, (h, w), e1, e2, t0,
+                             klass, sequence, products)
+
+    def submit_encoded(self, e1, e2, shape, client="default", klass=None,
+                       sequence=False, products=False):
+        """Admit one *pre-encoded* pair: bucket-shaped arrays already in
+        the session's wire dtype (the fleet front-end path — the client
+        or router encoded at the edge, the bytes land on device
+        untouched). ``shape`` is the original (H, W) the response crops
+        to; the bucket is the arrays' spatial extent and must be one of
+        the configured buckets. Same typed error/shed contract as
+        :meth:`submit`.
+        """
+        t0 = time.perf_counter()
+        with self._lock:
+            rid = self._rid
+            self._rid += 1
+
+        try:
+            if sequence:
+                if self.sessions is None:
+                    raise ServeError(
+                        "no_video",
+                        "sequence requests need a video session "
+                        "(serve --video)")
+                klass = ("fast" if getattr(self.session, "ladder", None)
+                         is not None else "")
+            else:
+                klass = self._validate_klass(klass)
+            for img in (e1, e2):
+                if not isinstance(img, np.ndarray) or img.ndim != 3 \
+                        or img.shape[-1] != 3:
+                    raise ServeError(
+                        "malformed",
+                        f"expected bucket-shaped HWC wire arrays, got "
+                        f"{getattr(img, 'shape', type(img).__name__)}")
+            if e1.shape != e2.shape:
+                raise ServeError(
+                    "malformed", f"pair shapes differ: {e1.shape} vs "
+                                 f"{e2.shape}")
+            want = getattr(self.session, "image_dtype", None)
+            if want is not None and e1.dtype != want():
+                raise ServeError(
+                    "malformed",
+                    f"wire dtype {e1.dtype} does not match the "
+                    f"session's {want()}")
+            bucket = (int(e1.shape[0]), int(e1.shape[1]))
+            if bucket not in self.session.buckets.sizes:
+                raise ServeError(
+                    "oversized",
+                    f"{bucket[0]}x{bucket[1]} is not a configured "
+                    f"bucket ({self.session.buckets.describe()})")
+            h, w = int(shape[0]), int(shape[1])
+            if h > bucket[0] or w > bucket[1] or h < 1 or w < 1:
+                raise ServeError(
+                    "malformed",
+                    f"crop shape {h}x{w} outside bucket "
+                    f"{bucket[0]}x{bucket[1]}")
+        except ServeError as e:
+            self._m_errors.labels(error=e.kind).inc()
+            telemetry.get().emit("serve", event="error", rid=rid,
+                                 client=client, error=e.kind)
+            raise
+
+        return self._enqueue(rid, client, bucket, (h, w), e1, e2, t0,
+                             klass, sequence, products)
+
+    def _enqueue(self, rid, client, bucket, shape, e1, e2, t0, klass,
+                 sequence, products):
         ticket = Ticket(rid, client)
         rtrace = trace_mod.RequestTrace(klass=klass, bucket=bucket)
         rtrace.mark("submit", t0)
         req = FlowRequest(rid=rid, client=client, seq=0, bucket=bucket,
-                          shape=(h, w), img1=e1, img2=e2, ticket=ticket,
+                          shape=shape, img1=e1, img2=e2, ticket=ticket,
                           t_submit=t0, klass=klass,
                           sequence=bool(sequence), products=bool(products),
                           trace=rtrace)
@@ -463,6 +532,15 @@ class Scheduler:
             return None
         fy, fx = self._carry_factor
         return (int(round(bucket[0] / fy)), int(round(bucket[1] / fx)), 2)
+
+    def carry_shapes(self):
+        """Every configured bucket's expected carry shape — what an
+        imported session-handoff snapshot must match — or None until the
+        model's downsampling factor has been observed (then the
+        cache's shape-checked lookup is the only guard)."""
+        if self._carry_factor is None:
+            return None
+        return {self._carry_shape(b) for b in self.session.buckets.sizes}
 
     def _gather_carry(self, live, bucket, fill):
         """Per-member cached carries stacked into one batch array.

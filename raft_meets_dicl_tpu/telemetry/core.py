@@ -41,7 +41,7 @@ SCHEMA_VERSION = 1
 # readers keep working); a reader seeing ``v`` with the same major but a
 # larger fractional minor (e.g. 1.2 from a newer producer) should skip
 # the record, not reject the file — see :class:`NewerSchema`.
-SCHEMA_MINOR = 4
+SCHEMA_MINOR = 5
 
 # kind -> required payload fields (beyond the {v, t, kind} envelope).
 # Extra fields are allowed everywhere: the schema pins the floor a
@@ -72,7 +72,8 @@ SCHEMA = {
     # when the training state is placed on the mesh
     "sharding": {"mesh", "params_bytes_per_chip", "opt_bytes_per_chip"},
     # compiled-program registry (PR 7): one event per AOT artifact
-    # interaction — event is save | hit | miss | fallback, with program
+    # interaction — event is save | hit | miss | fallback (plus the
+    # fleet store transfers publish | fetch), with program
     # kind/model/digest and bytes/seconds where applicable. A 'fallback'
     # means an artifact existed but could not be used (corruption,
     # version mismatch, incompatible inputs): the boot paid a cold JIT
@@ -135,8 +136,17 @@ SCHEMA = {
     "video": {"event"},
     # serve video-session cache (video.cache.SessionCache): event is
     # hit (warm-start state served) | miss (cold start: absent, expired,
-    # or shape mismatch) | evict (capacity LRU or TTL expiry)
+    # or shape mismatch) | evict (capacity LRU or TTL expiry) | import
+    # (a handed-off carry snapshot installed on the fleet handoff path)
     "session": {"event"},
+    # serving fleet (fleet/, PR 20): event is route (one request
+    # dispatched to a replica) | retry (safe-failure re-dispatch) |
+    # shed (typed fleet rejection, reason = queue_full |
+    # replica_unavailable) | drain (burn/liveness-triggered replica
+    # drain) | handoff (one sticky session's carry moved or evicted,
+    # outcome = moved | evicted) | replica_up | replica_down |
+    # restart (supervisor respawn, with backoff_ms)
+    "fleet": {"event"},
     # graftprof measured attribution (PR 16): one event per profiled
     # program — measured device seconds vs the roofline-predicted
     # seconds, per-op-class breakdown, the machine the calibration ran

@@ -511,6 +511,51 @@ def serve_stats(events):
     }
 
 
+def fleet_stats(events):
+    """Aggregate the serving-fleet plane (PR 20): routed requests per
+    replica, safe-failure retries, typed fleet sheds, drains by trigger,
+    session handoffs by outcome, and supervisor restarts."""
+    flt = [e for e in events if e["kind"] == "fleet"]
+    if not flt:
+        return {}
+    stats = {
+        "routes": 0, "per_replica": {}, "retries": 0,
+        "sheds": {}, "drains": {}, "handoffs": {},
+        "replicas_up": 0, "replicas_down": 0, "restarts": [],
+    }
+    for e in flt:
+        ev = e.get("event")
+        if ev == "route":
+            stats["routes"] += 1
+            r = str(e.get("replica", "?"))
+            stats["per_replica"][r] = stats["per_replica"].get(r, 0) + 1
+        elif ev == "retry":
+            stats["retries"] += 1
+        elif ev == "shed":
+            reason = e.get("reason", "?")
+            stats["sheds"][reason] = stats["sheds"].get(reason, 0) + 1
+        elif ev == "drain":
+            # both sides emit a drain event (router trigger + replica
+            # acknowledgement); count triggers by reason once per side
+            reason = e.get("reason", e.get("source", "?"))
+            stats["drains"][reason] = stats["drains"].get(reason, 0) + 1
+        elif ev == "handoff":
+            outcome = e.get("outcome", "?")
+            stats["handoffs"][outcome] = \
+                stats["handoffs"].get(outcome, 0) + 1
+        elif ev == "replica_up":
+            stats["replicas_up"] += 1
+        elif ev == "replica_down":
+            stats["replicas_down"] += 1
+        elif ev == "restart":
+            stats["restarts"].append({
+                "replica": e.get("replica"),
+                "exit_code": e.get("exit_code"),
+                "backoff_ms": e.get("backoff_ms"),
+            })
+    return stats
+
+
 def video_stats(events):
     """Aggregate the streaming-video plane (PR 15): ``video`` frame and
     sequence events from the sequence runner / bench, ``session``
@@ -897,6 +942,35 @@ def render(events, errors=(), warmup_steps=DEFAULT_WARMUP_STEPS,
                 f"serve batches: {b['batches']} video batches, "
                 f"{b['requests']} requests ({b['warm']} warm members, "
                 f"{b['products']} with fw/bw products)")
+
+    flt = fleet_stats(events)
+    if flt:
+        lines.append("")
+        lines.append("== fleet ==")
+        per = ", ".join(f"{r}={n}" for r, n in
+                        sorted(flt["per_replica"].items()))
+        lines.append(
+            f"routed: {flt['routes']} requests"
+            + (f" ({per})" if per else "")
+            + (f", {flt['retries']} retries" if flt["retries"] else ""))
+        if flt["sheds"]:
+            lines.append("sheds:  " + ", ".join(
+                f"{r}={n}" for r, n in sorted(flt["sheds"].items())))
+        if flt["drains"]:
+            lines.append("drains: " + ", ".join(
+                f"{r}={n}" for r, n in sorted(flt["drains"].items())))
+        if flt["handoffs"]:
+            lines.append("handoffs: " + ", ".join(
+                f"{o}={n}" for o, n in sorted(flt["handoffs"].items())))
+        if flt["replicas_up"] or flt["replicas_down"]:
+            lines.append(
+                f"membership: {flt['replicas_up']} up, "
+                f"{flt['replicas_down']} down, "
+                f"{len(flt['restarts'])} supervisor restarts")
+        for r in flt["restarts"][:8]:
+            lines.append(
+                f"  restart replica {r['replica']}: exit "
+                f"{r['exit_code']}, backoff {r['backoff_ms']} ms")
 
     traces = trace_stats(events)
     if traces:

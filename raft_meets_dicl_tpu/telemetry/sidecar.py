@@ -152,8 +152,9 @@ class SidecarServer:
     """The bound HTTP server + its daemon thread."""
 
     def __init__(self, observer, port, host="127.0.0.1",
-                 thread_name="obs-sidecar"):
-        handler = type("BoundHandler", (Handler,), {"observer": observer})
+                 thread_name="obs-sidecar", handler_cls=None):
+        handler = type("BoundHandler", (handler_cls or Handler,),
+                       {"observer": observer})
         self.observer = observer
         self.httpd = ThreadingHTTPServer((host, int(port)), handler)  # graftlint: disable=host-sync -- TCP port number, not a device value
         self.httpd.daemon_threads = True

@@ -41,6 +41,24 @@ the call sites that consult them:
     request's ticket must complete with a typed decode ServeError while
     the rest of its batch still dispatches (no poisoning, no dispatch-
     loop stall).
+``kill_replica@replica=R[;after=N]``
+    fleet.replica hard-exits (``os._exit``) serve replica R — after it
+    has *completed* N requests (default 1), so the kill lands mid-stream
+    under load. The supervisor must restart it (backoff), the router
+    must re-dispatch safe failures and hand off / evict its sticky
+    sessions, and the rejoined replica must serve warm with zero
+    compiles. Pair with ``RMD_FAULT_STATE`` so the respawned replica
+    does not re-fire.
+``hang_replica@replica=R[;after=N;seconds=S]``
+    fleet.replica wedges replica R's request handling for S seconds
+    (default 3600 — effectively forever) after N completed requests: the
+    process stays up and /healthz keeps answering, but requests stall.
+    Exercises the router's per-request deadline path.
+``slow_replica@replica=R[;ms=M;times=T]``
+    fleet.replica sleeps M ms (default 250) before handling a request on
+    replica R, T times — degraded-but-alive: latency (and SLO burn)
+    climbs without the process failing, which is what the burn-triggered
+    drain watches for.
 
 Firing is once per directive by default (``times`` raises the budget).
 Counters are per-process; when a fault must fire exactly once *across*

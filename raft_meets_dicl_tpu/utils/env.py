@@ -229,6 +229,28 @@ KNOBS = dict([
     _k("RMD_VIDEO_WARM_ITERATIONS", "int", 4,
        "warm-start program iteration budget for ladderless video serve "
        "sessions (with --ladder the bottom rung wins)", "serve"),
+    # -- serving fleet -----------------------------------------------------
+    _k("RMD_FLEET_REPLICAS", "int", 2,
+       "replica process count for the serving fleet (serve --fleet); "
+       "CLI --fleet wins", "fleet"),
+    _k("RMD_FLEET_RETRIES", "int", 2,
+       "router retry budget per request on safe failures (connection "
+       "refused/reset, replica shed) before the typed fleet shed",
+       "fleet"),
+    _k("RMD_FLEET_TIMEOUT_MS", "float", 30000.0,
+       "per-request router deadline (ms) covering dispatch + retries; "
+       "past it the request fails with a typed replica_unavailable",
+       "fleet"),
+    _k("RMD_FLEET_BURN_DRAIN", "float", 2.0,
+       "SLO burn rate above which the router drains a replica (hands "
+       "off its sticky sessions, stops routing to it, recycles it)",
+       "fleet"),
+    _k("RMD_FLEET_BACKOFF_MS", "float", 500.0,
+       "supervisor restart backoff base (ms); doubles per consecutive "
+       "crash, capped at 30 s, +-25% jitter", "fleet"),
+    _k("RMD_FLEET_HEALTH_S", "float", 0.5,
+       "router/supervisor health poll interval (seconds): /healthz "
+       "liveness + /statusz SLO burn per replica", "fleet"),
     # -- fault injection / harness -----------------------------------------
     _k("RMD_FAULT", "str", "",
        "deterministic fault injection spec (testing.faults)", "faults"),
@@ -241,7 +263,7 @@ KNOBS = dict([
 ])
 
 _SECTIONS = ("telemetry", "input", "training", "parallel", "compile",
-             "models", "serve", "faults")
+             "models", "serve", "fleet", "faults")
 
 
 def knob(name):

@@ -15,13 +15,14 @@ The single-pair estimator becomes a video engine by composition:
   serve scheduler keys warm-start state on.
 """
 
-from .cache import SessionCache
+from .cache import CarryMismatch, SessionCache
 from .products import fw_bw_products, fw_bw_products_batch, warp_flow
 from .sequence import (FrameResult, SequenceResult, SequenceRunner,
                        fw_bw_flows)
 from .warmstart import project_flow
 
 __all__ = [
+    "CarryMismatch",
     "SessionCache",
     "fw_bw_products",
     "fw_bw_products_batch",

@@ -21,12 +21,22 @@ counted as ``rmd_serve_session_*`` metrics and ``session`` telemetry
 events.
 """
 
+import base64
 import threading
 import time
+import zlib
+
+import numpy as np
 
 from .. import telemetry
 from ..telemetry import metrics as metrics_mod
 from ..utils import env
+
+
+class CarryMismatch(ValueError):
+    """An imported carry snapshot failed validation (shape/dtype/CRC):
+    the receiving replica must start the session cold rather than feed a
+    damaged or mis-shaped carry into a warm program."""
 
 
 class SessionCache:
@@ -137,3 +147,85 @@ class SessionCache:
             active = len(self._entries)
         self._m_active.set(active)
         return had
+
+    def clients(self):
+        """Live (unexpired) client ids, LRU to MRU — what a draining
+        replica must hand off."""
+        now = self._clock()
+        with self._lock:
+            self._expire_locked(now)
+            return list(self._entries)
+
+    # -- handoff snapshots ----------------------------------------------------
+
+    def export_carry(self, client):
+        """Serializable snapshot of the client's carry, or None.
+
+        The snapshot is a plain JSON-safe dict — shape, dtype, CRC32 and
+        base64 payload — so it can cross a process boundary on the fleet
+        handoff path (``/sessionz``). Validation happens on import; the
+        exporting side never mutates the session (the source replica
+        keeps serving until the router flips affinity).
+        """
+        now = self._clock()
+        with self._lock:
+            self._expire_locked(now)
+            entry = self._entries.get(client)
+            if entry is None:
+                return None
+            flow = entry[0]
+        flow = np.ascontiguousarray(flow)
+        raw = flow.tobytes()
+        return {
+            "client": client,
+            "shape": list(flow.shape),
+            "dtype": str(flow.dtype),
+            "crc": zlib.crc32(raw),
+            "data": base64.b64encode(raw).decode("ascii"),
+        }
+
+    def import_carry(self, snapshot, client=None, shape=None):
+        """Install an exported snapshot as ``client``'s carry.
+
+        Validates structure, dtype, byte length against the declared
+        shape, the CRC, and (when the receiving scheduler knows its
+        coarse-grid geometry) the expected carry ``shape`` — raising
+        :class:`CarryMismatch` on any failure so the caller degrades the
+        stream to one cold frame instead of corrupting it. Returns the
+        installed carry array.
+        """
+        if not isinstance(snapshot, dict):
+            raise CarryMismatch(f"snapshot is not an object: "
+                                f"{type(snapshot).__name__}")
+        missing = {"shape", "dtype", "crc", "data"} - snapshot.keys()
+        if missing:
+            raise CarryMismatch(f"snapshot missing {sorted(missing)}")
+        client = client or snapshot.get("client")
+        if not client:
+            raise CarryMismatch("snapshot names no client")
+        try:
+            dtype = np.dtype(snapshot["dtype"])
+        except TypeError as e:
+            raise CarryMismatch(f"bad dtype {snapshot['dtype']!r}: {e}") \
+                from e
+        try:
+            raw = base64.b64decode(snapshot["data"], validate=True)
+        except Exception as e:  # noqa: BLE001 - any decode failure is a mismatch
+            raise CarryMismatch(f"payload decode failed: {e}") from e
+        declared = tuple(int(d) for d in snapshot["shape"])
+        if shape is not None and declared != tuple(shape):
+            raise CarryMismatch(
+                f"carry shape {declared} does not match the receiving "
+                f"replica's expected {tuple(shape)}")
+        expect_bytes = int(np.prod(declared)) * dtype.itemsize if declared \
+            else dtype.itemsize
+        if len(raw) != expect_bytes:
+            raise CarryMismatch(
+                f"payload is {len(raw)} bytes, shape {declared} "
+                f"{dtype} needs {expect_bytes}")
+        if zlib.crc32(raw) != int(snapshot["crc"]):
+            raise CarryMismatch("payload CRC mismatch")
+        flow = np.frombuffer(raw, dtype=dtype).reshape(declared).copy()
+        self.put(client, flow)
+        self._emit("import", client)
+        return flow

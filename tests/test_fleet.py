@@ -1,0 +1,591 @@
+"""Fault-tolerant serving fleet tests (PR 20).
+
+Everything runs on tiny CPU shapes with host-only fake sessions behind
+*real* HTTP replica servers on 127.0.0.1 ephemeral ports — the wire
+framing, routing policy, drain/handoff, and chaos paths are exactly the
+production code; only the device work is faked. The supervisor tests
+spawn real child processes (a stdlib HTTP stub standing in for a
+replica) so restart/backoff is tested against actual process death.
+"""
+
+import base64
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from raft_meets_dicl_tpu import telemetry
+from raft_meets_dicl_tpu.fleet import (
+    EdgeCodec, ReplicaClient, Router, Supervisor, run_drill,
+    serve_frontend, serve_replica)
+from raft_meets_dicl_tpu.fleet import wire as fwire
+from raft_meets_dicl_tpu.models.input import ShapeBuckets
+from raft_meets_dicl_tpu.serve.batcher import ServeError, ServeRejected
+from raft_meets_dicl_tpu.serve.observe import Observer
+from raft_meets_dicl_tpu.serve.scheduler import Scheduler
+from raft_meets_dicl_tpu.testing import faults
+from raft_meets_dicl_tpu.video.cache import CarryMismatch, SessionCache
+
+pytestmark = pytest.mark.fleet
+
+BUCKETS = [(16, 24), (32, 48)]
+
+
+@pytest.fixture(autouse=True)
+def _fleet_hygiene(monkeypatch):
+    """Every test starts unarmed with a fresh memory telemetry sink."""
+    monkeypatch.delenv("RMD_FAULT", raising=False)
+    monkeypatch.delenv("RMD_FAULT_STATE", raising=False)
+    faults.reset()
+    sink = telemetry.activate(telemetry.Telemetry())
+    yield sink
+    telemetry.deactivate()
+    faults.reset()
+
+
+def _events(sink, event):
+    return [e for e in sink.events
+            if e["kind"] == "fleet" and e.get("event") == event]
+
+
+class FakeVideoSession:
+    """Host-only video-capable stand-in: flow = enc(img1)+enc(img2)
+    (+ upsampled carry), coarse carry = 4x-strided flow."""
+
+    video = True
+    ready = True
+
+    def __init__(self, buckets, batch_size=2, delay_s=0.0):
+        self.buckets = buckets
+        self.batch_size = batch_size
+        self.delay_s = delay_s
+
+    def encode_image(self, img):
+        return np.asarray(img, np.float32) * 2.0 - 1.0
+
+    def image_dtype(self):
+        return np.float32
+
+    def compiles(self):
+        return 0
+
+    def run(self, img1, img2):
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return (img1 + img2)[..., :2]
+
+    def run_video(self, img1, img2, carry=None):
+        flow = (img1 + img2)[..., :2]
+        if carry is not None:
+            flow = flow + carry.repeat(4, axis=1).repeat(4, axis=2)
+        coarse = flow[:, ::4, ::4, :]
+        return flow, {"flow": coarse}, {"rungs": 1, "iterations": 4}
+
+    def fetch(self, flow):
+        return np.asarray(flow)
+
+
+class InProcReplica:
+    """One fake replica behind a real HTTP server."""
+
+    def __init__(self, index, delay_s=0.0, queue_limit=64):
+        self.buckets = ShapeBuckets(BUCKETS)
+        self.session = FakeVideoSession(self.buckets, delay_s=delay_s)
+        self.scheduler = Scheduler(self.session, batch_size=2,
+                                   max_wait_ms=5.0,
+                                   queue_limit=queue_limit).start()
+        self.observer = Observer(self.session, self.scheduler)
+        self.server = serve_replica(self.session, self.scheduler,
+                                    self.observer, 0, index=index)
+        self.name = f"replica-{index}"
+        self.url = self.server.url
+
+    def close(self):
+        self.server.close()
+        self.scheduler.stop(drain=False)
+
+
+@pytest.fixture
+def duo():
+    """Two live replicas behind a router (health thread off: tests
+    drive poll_health deterministically)."""
+    reps = [InProcReplica(0), InProcReplica(1)]
+    codec = EdgeCodec(ShapeBuckets(BUCKETS))
+    router = Router(codec, retries=2, timeout_ms=20000.0,
+                    burn_drain=2.0)
+    for r in reps:
+        router.add_replica(r.name, r.url)
+    yield router, reps
+    router.stop()
+    for r in reps:
+        r.close()
+
+
+def _pair(shape, seed=0):
+    rng = np.random.default_rng(seed)
+    h, w = shape
+    return (rng.random((h, w, 3), dtype=np.float32),
+            rng.random((h, w, 3), dtype=np.float32))
+
+
+# -- satellite: SessionCache carry export/import ------------------------------
+
+
+def test_export_import_carry_bit_parity():
+    src, dst = SessionCache(), SessionCache()
+    flow = np.arange(4 * 6 * 2, dtype=np.float32).reshape(4, 6, 2)
+    src.put("clientA", flow)
+    snap = src.export_carry("clientA")
+    assert snap["client"] == "clientA"
+    assert snap["shape"] == [4, 6, 2]
+    restored = dst.import_carry(snap)
+    np.testing.assert_array_equal(restored, flow)
+    # the installed copy is what get() serves, bit for bit
+    np.testing.assert_array_equal(dst.get("clientA", (4, 6, 2)), flow)
+
+
+def test_export_carry_unknown_client_is_none():
+    assert SessionCache().export_carry("ghost") is None
+
+
+def test_import_carry_rejects_corruption():
+    src = SessionCache()
+    src.put("c", np.ones((4, 6, 2), np.float32))
+    good = src.export_carry("c")
+
+    bad_crc = dict(good, crc=good["crc"] ^ 1)
+    with pytest.raises(CarryMismatch):
+        SessionCache().import_carry(bad_crc)
+
+    bad_b64 = dict(good, data="!!not-base64!!")
+    with pytest.raises(CarryMismatch):
+        SessionCache().import_carry(bad_b64)
+
+    # declared shape disagreeing with the byte payload
+    bad_shape = dict(good, shape=[8, 6, 2])
+    with pytest.raises(CarryMismatch):
+        SessionCache().import_carry(bad_shape)
+
+    # caller-expected shape disagreeing with the snapshot
+    with pytest.raises(CarryMismatch):
+        SessionCache().import_carry(good, shape=(2, 3, 2))
+
+    truncated = dict(good, data=base64.b64encode(
+        base64.b64decode(good["data"])[:-4]).decode())
+    with pytest.raises(CarryMismatch):
+        SessionCache().import_carry(truncated)
+
+
+# -- wire framing -------------------------------------------------------------
+
+
+def test_edge_codec_roundtrip_and_bucket_assignment():
+    codec = EdgeCodec(ShapeBuckets(BUCKETS))
+    img1, img2 = _pair((14, 20))
+    e1, e2, bucket, shape = codec.encode_pair(img1, img2)
+    assert bucket == (16, 24) and shape == (14, 20)
+    meta, body = codec.request(img1, img2, "c", None, False)
+    r1, r2, rshape = fwire.unpack_pair(meta, body)
+    np.testing.assert_array_equal(r1, e1)
+    np.testing.assert_array_equal(r2, e2)
+    assert rshape == (14, 20)
+
+
+def test_edge_codec_typed_admission_errors():
+    codec = EdgeCodec(ShapeBuckets(BUCKETS))
+    with pytest.raises(ServeError) as e:
+        codec.encode_pair(*_pair((64, 64)))
+    assert e.value.kind == "oversized"
+    img1, _ = _pair((14, 20))
+    with pytest.raises(ServeError) as e:
+        codec.encode_pair(img1, _pair((16, 24))[0])
+    assert e.value.kind == "malformed"
+    with pytest.raises(ServeError) as e:
+        fwire.loads_meta("not json {")
+    assert e.value.kind == "malformed"
+
+
+def test_unpack_pair_rejects_byte_length_mismatch():
+    codec = EdgeCodec(ShapeBuckets(BUCKETS))
+    meta, body = codec.request(*_pair((14, 20)), "c", None, False)
+    with pytest.raises(ServeError) as e:
+        fwire.unpack_pair(meta, body[:-8])
+    assert e.value.kind == "malformed"
+
+
+# -- replica HTTP API ---------------------------------------------------------
+
+
+def test_replica_flow_over_http_and_typed_errors(_fleet_hygiene):
+    rep = InProcReplica(0)
+    try:
+        client = ReplicaClient(rep.url)
+        codec = EdgeCodec(rep.buckets)
+        meta, body = codec.request(*_pair((14, 20)), "c", None, False)
+        status, out_meta, out_body = client.flow(meta, body)
+        assert status == 200 and out_meta["replica"] == 0
+        flow, out_meta = fwire.unpack_result(out_meta, out_body)
+        assert flow.shape == (14, 20, 2)
+
+        # malformed meta answers a typed 400, not prose
+        status, out_meta, _ = client.flow({"bucket": [16, 24]}, b"")
+        assert status == 400 and out_meta["error"] == "malformed"
+
+        # healthz flips to 503 + draining body once drain begins
+        payload, status = client.health()
+        assert status == 200 and not payload.get("draining", False)
+        drain_payload, status = client.drain()
+        assert status == 200 and drain_payload["draining"]
+        payload, status = client.health()
+        assert status == 503 and payload["draining"] is True
+        # and new flow requests shed typed 'draining'
+        status, out_meta, _ = client.flow(meta, body)
+        assert status == 503 and out_meta["error"] == "draining"
+    finally:
+        rep.close()
+
+
+def test_replica_session_export_import_over_http():
+    rep_a, rep_b = InProcReplica(0), InProcReplica(1)
+    try:
+        ca, cb = ReplicaClient(rep_a.url), ReplicaClient(rep_b.url)
+        codec = EdgeCodec(rep_a.buckets)
+        # prime a sticky stream on A so it has a carry
+        for seed in range(2):
+            meta, body = codec.request(*_pair((16, 24), seed=seed),
+                                       "vid", None, True)
+            status, _, _ = ca.flow(meta, body)
+            assert status == 200
+        snap = ca.export_session("vid")
+        assert snap is not None and snap["client"] == "vid"
+        assert cb.import_session(snap)
+        # bit parity: B's cache now holds exactly A's carry bytes
+        snap_b = cb.export_session("vid")
+        assert snap_b["data"] == snap["data"]
+        assert snap_b["crc"] == snap["crc"]
+        # a corrupted snapshot is refused with a typed 400
+        assert not cb.import_session(dict(snap, crc=snap["crc"] ^ 1))
+    finally:
+        rep_a.close()
+        rep_b.close()
+
+
+# -- router: dispatch, affinity, retry, sheds ---------------------------------
+
+
+def test_router_routes_and_least_loaded_spread(duo, _fleet_hygiene):
+    router, reps = duo
+    tickets = [router.submit(*_pair((16, 24), seed=i), client=f"c{i}")
+               for i in range(8)]
+    for t in tickets:
+        res = t.result(timeout=15.0)
+        assert res.flow.shape == (16, 24, 2)
+    served = {e["replica"] for e in _events(_fleet_hygiene, "route")}
+    assert served == {"replica-0", "replica-1"}  # both took traffic
+
+
+def test_router_sticky_affinity_and_warm_stream(duo):
+    router, reps = duo
+    warm = []
+    for seed in range(4):
+        t = router.submit(*_pair((16, 24), seed=seed), client="stream",
+                          sequence=True)
+        warm.append(t.result(timeout=15.0).warm)
+    assert warm == [False, True, True, True]
+    assert router._affinity["stream"] in ("replica-0", "replica-1")
+
+
+def test_router_retries_safe_failure_to_other_replica(duo,
+                                                      _fleet_hygiene):
+    router, reps = duo
+    reps[0].close()  # connection refused: a *safe* transport failure
+    results = []
+    for i in range(4):
+        t = router.submit(*_pair((16, 24), seed=i), client=f"c{i}")
+        results.append(t.result(timeout=15.0))
+    assert all(r.flow.shape == (16, 24, 2) for r in results)
+    # the dead replica was marked down after the failed exchange
+    assert not router.replicas()["replica-0"].up
+    assert len(_events(_fleet_hygiene, "replica_down")) == 1
+
+
+def test_router_typed_shed_when_no_replica(duo, _fleet_hygiene):
+    router, reps = duo
+    for r in reps:
+        router.mark_down(r.name)
+    t = router.submit(*_pair((16, 24)), client="c")
+    with pytest.raises(ServeRejected) as e:
+        t.result(timeout=10.0)
+    assert e.value.reason == "replica_unavailable"
+    assert router.describe()["sheds"] == {"replica_unavailable": 1}
+    assert len(_events(_fleet_hygiene, "shed")) == 1
+
+
+def test_router_queue_full_shed_after_bounded_retry(duo,
+                                                    _fleet_hygiene):
+    router, reps = duo
+
+    class Always429:
+        def flow(self, meta, body, timeout=None):
+            return 429, {"error": "queue_full"}, b""
+
+    for state in router.replicas().values():
+        state.client = Always429()
+    t = router.submit(*_pair((16, 24)), client="c")
+    with pytest.raises(ServeRejected) as e:
+        t.result(timeout=10.0)
+    assert e.value.reason == "queue_full"
+    # retry budget honored: retries = router.retries, tries = retries+1
+    assert len(_events(_fleet_hygiene, "retry")) == router.retries
+
+
+# -- router: health-driven drain + handoff ------------------------------------
+
+
+class StubClient:
+    """Health/status stub standing in for a live ReplicaClient."""
+
+    def __init__(self, live=True, burn=0.0):
+        self.live = live
+        self.burn = burn
+        self.drained = False
+
+    def health(self, timeout=None):
+        return {"ready": True, "live": self.live,
+                "draining": False}, 200
+
+    def status(self, timeout=None):
+        return {"slo": {"fast": {"burn_rate": self.burn}}}
+
+    def drain(self, timeout=None):
+        self.drained = True
+        return {"draining": True}, 200
+
+
+def test_burn_crossing_drains_replica(duo, _fleet_hygiene):
+    router, reps = duo
+    hot = StubClient(burn=5.0)  # above the 2.0 drain threshold
+    router.replicas()["replica-0"].client = hot
+    router.poll_health()
+    state = router.replicas()["replica-0"]
+    assert state.draining and hot.drained
+    ev = _events(_fleet_hygiene, "drain")
+    assert [e["reason"] for e in ev if e.get("source") == "router"] \
+        == ["slo_burn"]
+    # a draining replica takes no new traffic; the other serves
+    res = router.submit(*_pair((16, 24)), client="c").result(timeout=15.0)
+    assert res.flow.shape == (16, 24, 2)
+
+
+def test_liveness_loss_drains_replica(duo, _fleet_hygiene):
+    router, reps = duo
+    router.replicas()["replica-1"].client = StubClient(live=False)
+    router.poll_health()
+    assert router.replicas()["replica-1"].draining
+    ev = [e for e in _events(_fleet_hygiene, "drain")
+          if e.get("source") == "router"]
+    assert ev and ev[0]["reason"] == "liveness"
+
+
+def test_drain_hands_off_sticky_carry_bit_parity(duo, _fleet_hygiene):
+    router, reps = duo
+    for seed in range(3):
+        t = router.submit(*_pair((16, 24), seed=seed), client="stream",
+                          sequence=True)
+        assert t.result(timeout=15.0) is not None
+    owner = router._affinity["stream"]
+    src = next(r for r in reps if r.name == owner)
+    dst = next(r for r in reps if r.name != owner)
+    before = src.scheduler.sessions.export_carry("stream")
+
+    router.drain_replica(owner, reason="test")
+    assert router._affinity["stream"] == dst.name
+    after = dst.scheduler.sessions.export_carry("stream")
+    assert after["data"] == before["data"]  # bit-identical carry moved
+    ev = _events(_fleet_hygiene, "handoff")
+    assert ev and ev[0]["outcome"] == "moved" \
+        and ev[0]["target"] == dst.name
+    # the stream's next frame is warm on the new owner
+    t = router.submit(*_pair((16, 24), seed=9), client="stream",
+                      sequence=True)
+    assert t.result(timeout=15.0).warm
+
+
+def test_replica_death_evicts_sticky_sessions(duo, _fleet_hygiene):
+    router, reps = duo
+    for seed in range(2):
+        router.submit(*_pair((16, 24), seed=seed), client="stream",
+                      sequence=True).result(timeout=15.0)
+    owner = router._affinity["stream"]
+    router.mark_down(owner, reason="died")
+    assert "stream" not in router._affinity
+    ev = _events(_fleet_hygiene, "handoff")
+    assert ev and ev[0]["outcome"] == "evicted"
+    # the stream survives: exactly one cold frame, then warm again
+    warm = []
+    for seed in range(3):
+        t = router.submit(*_pair((16, 24), seed=seed), client="stream",
+                          sequence=True)
+        warm.append(t.result(timeout=15.0).warm)
+    assert warm == [False, True, True]
+
+
+# -- front-end HTTP surface ---------------------------------------------------
+
+
+def test_frontend_serves_wire_clients_end_to_end(duo):
+    router, reps = duo
+    frontend = serve_frontend(router, 0)
+    try:
+        client = ReplicaClient(frontend.url)
+        codec = EdgeCodec(ShapeBuckets(BUCKETS))
+        meta, body = codec.request(*_pair((14, 20)), "c", None, False)
+        status, out_meta, out_body = client.flow(meta, body)
+        assert status == 200
+        flow, _ = fwire.unpack_result(out_meta, out_body)
+        assert flow.shape == (14, 20, 2)
+        payload, status = client.health()
+        assert status == 200 and payload["ready"]
+        status, fleetz, _ = client._request("GET", "/fleetz")
+        assert status == 200 and len(fleetz["replicas"]) == 2
+    finally:
+        frontend.close()
+
+
+# -- supervisor: restart + backoff --------------------------------------------
+
+_STUB_REPLICA = """
+import http.server, json, sys
+class H(http.server.BaseHTTPRequestHandler):
+    def do_GET(self):
+        b = json.dumps({"ready": True, "live": True}).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(b)))
+        self.end_headers()
+        self.wfile.write(b)
+    def log_message(self, *a):
+        pass
+srv = http.server.HTTPServer(("127.0.0.1", 0), H)
+with open(sys.argv[1], "w") as f:
+    f.write(str(srv.server_address[1]))
+srv.serve_forever()
+"""
+
+
+def test_supervisor_restarts_killed_replica(tmp_path, _fleet_hygiene):
+    ups, downs = [], []
+
+    def spawn(index, port_file):
+        return subprocess.Popen(
+            [sys.executable, "-c", _STUB_REPLICA, port_file])
+
+    sup = Supervisor(spawn, 2,
+                     on_up=lambda i, url: ups.append(i),
+                     on_down=lambda i: downs.append(i),
+                     backoff_ms=50.0, poll_s=0.05, workdir=tmp_path)
+    try:
+        sup.start(wait_ready=True)
+        assert all(s.url for s in sup.slots)
+        sup.kill(0)
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            if sup.slots[0].restarts >= 1 and sup.slots[0].url:
+                break
+            time.sleep(0.05)
+        assert downs == [0]
+        assert sup.slots[0].restarts == 1
+        assert sup.slots[0].url  # rendezvoused + healthz-gated again
+        assert ups.count(0) >= 1
+        ev = _events(_fleet_hygiene, "restart")
+        assert ev and ev[0]["replica"] == 0 and ev[0]["backoff_ms"] > 0
+    finally:
+        sup.stop()
+
+
+def test_supervisor_backoff_grows_on_crash_loop(tmp_path):
+    def spawn(index, port_file):
+        return subprocess.Popen([sys.executable, "-c", "pass"])
+
+    sup = Supervisor(spawn, 1, backoff_ms=40.0, poll_s=0.02,
+                     workdir=tmp_path)
+    try:
+        sup.slots[0].port_file = tmp_path / "r0.port"
+        sup._spawn_slot(sup.slots[0])
+        sup._thread = threading.Thread(target=sup._monitor, daemon=True)
+        sup._thread.start()
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline and sup.slots[0].crashes < 3:
+            time.sleep(0.05)
+        assert sup.slots[0].crashes >= 3  # kept respawning
+        # consecutive crashes double the gate: 40 -> 80 -> 160 (±25%)
+        gate = sup.slots[0].restart_after - time.monotonic()
+        assert gate > 0.04 * (2 ** (sup.slots[0].crashes - 1)) * 0.5
+    finally:
+        sup.stop()
+
+
+# -- chaos triggers + kill/rejoin drill ---------------------------------------
+
+
+def test_fault_kill_replica_directive_parses(monkeypatch):
+    monkeypatch.setenv("RMD_FAULT", "kill_replica@replica=1;after=3")
+    faults.reset()
+    assert faults.fire("kill_replica", replica=0, after=3) is None
+    assert faults.fire("kill_replica", replica=1, after=2) is None
+    assert faults.fire("kill_replica", replica=1, after=3) is not None
+
+
+def test_slow_replica_fault_delays_requests(monkeypatch,
+                                            _fleet_hygiene):
+    monkeypatch.setenv("RMD_FAULT", "slow_replica@replica=0;ms=80;times=1")
+    faults.reset()
+    rep = InProcReplica(0)
+    try:
+        client = ReplicaClient(rep.url)
+        codec = EdgeCodec(rep.buckets)
+        meta, body = codec.request(*_pair((16, 24)), "c", None, False)
+        t0 = time.monotonic()
+        status, _, _ = client.flow(meta, body)
+        assert status == 200
+        assert time.monotonic() - t0 >= 0.08
+    finally:
+        rep.close()
+
+
+def test_kill_rejoin_drill_in_process(_fleet_hygiene):
+    reps = {i: InProcReplica(i) for i in range(2)}
+    codec = EdgeCodec(ShapeBuckets(BUCKETS))
+    router = Router(codec, retries=2, timeout_ms=20000.0)
+    for r in reps.values():
+        router.add_replica(r.name, r.url)
+
+    def kill(owner):
+        index = int(owner.rsplit("-", 1)[1]) if owner else 0
+        reps[index].close()
+        router.mark_down(f"replica-{index}", reason="killed")
+
+        def rejoin():
+            time.sleep(0.3)
+            reps[index] = InProcReplica(index)
+            router.add_replica(reps[index].name, reps[index].url)
+
+        threading.Thread(target=rejoin, daemon=True).start()
+        return f"replica-{index}"
+
+    try:
+        report = run_drill(router, kill, BUCKETS, frames=12,
+                           kill_after=4, rejoin_wait_s=30.0,
+                           background_per_frame=1)
+    finally:
+        router.stop()
+        for r in reps.values():
+            r.close()
+    assert report["dropped"] == 0, report["errors"]
+    assert report["cold_frames"] <= 1
+    assert report["rejoined"] and report["killed"] is not None
+    assert report["rejoin_compiles"] == 0
+    assert report["ok"], report
